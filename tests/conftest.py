@@ -1,0 +1,20 @@
+"""Test harness config.
+
+Control-plane tests are pure Python. Compute-plane tests (models/, parallel/)
+run JAX on a virtual 8-device CPU mesh — the MiniYARNCluster analogue for
+sharding (SURVEY.md §4): multi-chip layouts compile and execute without TPU
+hardware. The env vars must be set before jax initializes its backends, hence
+the sitecustomize-style assignment at import time here.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in existing:
+    os.environ["XLA_FLAGS"] = (
+        existing + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

@@ -1,0 +1,94 @@
+"""Config-system unit tests (reference tier: TestTonyConfigurationKeys/TestUtils)."""
+
+import textwrap
+
+import pytest
+
+from tony_tpu import conf as C
+from tony_tpu.conf import TonyConfig
+
+
+def test_defaults_layer():
+    cfg = TonyConfig()
+    assert cfg.get(C.APPLICATION_FRAMEWORK) == "jax"
+    assert cfg.get_int(C.TASK_MAX_MISSED_HEARTBEATS) == 25
+    assert cfg.get_bool(C.DOCKER_ENABLED) is False
+
+
+def test_xml_compat_load(tmp_path):
+    xml = textwrap.dedent("""\
+        <configuration>
+          <property><name>tony.worker.instances</name><value>4</value></property>
+          <property><name>tony.worker.memory</name><value>8g</value></property>
+          <property><name>tony.application.framework</name><value>tensorflow</value></property>
+        </configuration>""")
+    p = tmp_path / "tony.xml"
+    p.write_text(xml)
+    cfg = TonyConfig.load(p)
+    assert cfg.instances("worker") == 4
+    assert cfg.get_memory_mb(C.memory_key("worker")) == 8192
+    assert cfg.get(C.APPLICATION_FRAMEWORK) == "tensorflow"
+
+
+def test_json_load_and_overrides(tmp_path):
+    p = tmp_path / "job.json"
+    p.write_text('{"tony.worker.instances": 2, "tony.worker.vcores": 3}')
+    cfg = TonyConfig.load(p)
+    cfg.merge_overrides({"tony.worker.vcores": "5"})
+    assert cfg.get_int(C.vcores_key("worker")) == 5
+    assert cfg.instances("worker") == 2
+
+
+def test_open_jobtype_templating():
+    # Any user-invented job type works without code changes (SURVEY.md §5.6).
+    cfg = TonyConfig({
+        "tony.chief.instances": "1",
+        "tony.worker.instances": "2",
+        "tony.evaluator.instances": "1",
+        "tony.dbwriter.instances": "1",      # invented type
+        "tony.dbwriter.memory": "512m",
+    })
+    assert cfg.job_types() == ["chief", "dbwriter", "evaluator", "worker"]
+    assert cfg.total_tasks() == 5
+    req = cfg.container_request("dbwriter")
+    assert req.memory_mb == 512 and req.instances == 1
+
+
+def test_reserved_segments_not_jobtypes():
+    cfg = TonyConfig({"tony.worker.instances": "1",
+                      "tony.am.instances": "9"})  # 'am' is reserved
+    assert cfg.job_types() == ["worker"]
+
+
+def test_untracked_jobtypes():
+    cfg = TonyConfig({"tony.worker.instances": "1", "tony.ps.instances": "2"})
+    assert not cfg.is_tracked("ps")
+    assert cfg.is_tracked("worker")
+    cfg.set(C.APPLICATION_UNTRACKED, "worker")
+    assert not cfg.is_tracked("worker")
+    assert cfg.is_tracked("ps")
+
+
+def test_task_env_csv():
+    cfg = TonyConfig({"tony.worker.instances": "1",
+                      "tony.worker.env": "FOO=1,BAR=a=b"})
+    assert cfg.task_env("worker") == {"FOO": "1", "BAR": "a=b"}
+
+
+def test_validate_rejects_bad_framework():
+    cfg = TonyConfig({"tony.worker.instances": "1",
+                      C.APPLICATION_FRAMEWORK: "caffe"})
+    with pytest.raises(ValueError, match="unknown"):
+        cfg.validate()
+
+
+def test_validate_requires_jobtype():
+    with pytest.raises(ValueError, match="no job types"):
+        TonyConfig().validate()
+
+
+def test_json_roundtrip():
+    cfg = TonyConfig({"tony.worker.instances": "3"})
+    clone = TonyConfig.from_json(cfg.to_json())
+    assert clone.instances("worker") == 3
+    assert dict(clone.items()) == dict(cfg.items())

@@ -1,0 +1,147 @@
+"""Runtime-adapter unit tests: buildTaskEnv output given a fake cluster spec
+(reference tier: TestHorovodRuntime etc., SURVEY.md §4)."""
+
+import json
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.conf import TonyConfig
+from tony_tpu.runtime import TaskContext, get_framework
+from tony_tpu.runtime.horovod_driver import HorovodDriver, compute_slots, fetch_slots
+from tony_tpu.runtime.horovod_runtime import CALLBACK_RENDEZVOUS_ADDR
+
+SPEC = {
+    "chief": ["h0:4000"],
+    "worker": ["h0:4001", "h1:4002", "h1:4003"],
+}
+
+
+def ctx_for(framework, job_type, index, spec=None, conf_extra=None, callback=None):
+    props = {"tony.chief.instances": "1", "tony.worker.instances": "3",
+             "tony.application.framework": framework}
+    props.update(conf_extra or {})
+    return TaskContext(
+        conf=TonyConfig(props), job_type=job_type, index=index,
+        cluster_spec=spec or SPEC, am_address="am:9000",
+        app_id="app_1_0001", callback_info=callback or {})
+
+
+def test_common_env():
+    env = get_framework("standalone").task_adapter().build_task_env(
+        ctx_for("standalone", "worker", 1))
+    assert env[constants.ENV_JOB_TYPE] == "worker"
+    assert env[constants.ENV_TASK_INDEX_USER] == "1"
+    assert env[constants.ENV_TASK_NUM] == "4"
+    assert json.loads(env[constants.ENV_DIST_SPEC]) == SPEC
+    assert env[constants.ENV_AM_ADDRESS] == "am:9000"
+
+
+def test_tf_config():
+    env = get_framework("tensorflow").task_adapter().build_task_env(
+        ctx_for("tensorflow", "worker", 2))
+    tf_config = json.loads(env[constants.ENV_TF_CONFIG])
+    assert tf_config["cluster"] == SPEC
+    assert tf_config["task"] == {"type": "worker", "index": 2}
+
+
+def test_tf_config_excludes_sidecars():
+    spec = dict(SPEC, tensorboard=["h9:5000"])
+    env = get_framework("tensorflow").task_adapter().build_task_env(
+        ctx_for("tensorflow", "chief", 0, spec=spec,
+                conf_extra={"tony.tensorboard.instances": "1"}))
+    assert "tensorboard" not in json.loads(env[constants.ENV_TF_CONFIG])["cluster"]
+
+
+def test_pytorch_ddp_env():
+    env = get_framework("pytorch").task_adapter().build_task_env(
+        ctx_for("pytorch", "worker", 1))
+    # Coordinator is global rank 0 = chief:0.
+    assert env[constants.ENV_MASTER_ADDR] == "h0"
+    assert env[constants.ENV_MASTER_PORT] == "4000"
+    assert env[constants.ENV_WORLD_SIZE] == "4"
+    assert env[constants.ENV_RANK] == "2"          # chief=0, worker0=1, worker1=2
+    assert env[constants.ENV_LOCAL_RANK] == "0"    # first task on h1
+    assert env[constants.ENV_INIT_METHOD] == "tcp://h0:4000"
+
+
+def test_jax_coordinator_env():
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0))
+    assert env[constants.ENV_COORDINATOR_ADDRESS] == "h0:4000"
+    assert env[constants.ENV_PROCESS_ID] == "1"
+    assert env[constants.ENV_NUM_PROCESSES] == "4"
+    assert env[constants.ENV_TPU_WORKER_ID] == "1"
+    assert env[constants.ENV_TPU_WORKER_HOSTNAMES] == "h0,h0,h1,h1"
+
+
+def test_jax_chip_pinning():
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 2, conf_extra={"tony.worker.tpus": "2"}))
+    # worker:2 is the second task on h1 -> local_rank 1 -> chips 2,3
+    assert env[constants.ENV_TPU_VISIBLE_DEVICES] == "2,3"
+
+
+def test_jax_rejects_ps():
+    fw = get_framework("jax")
+    conf = TonyConfig({"tony.ps.instances": "2", "tony.worker.instances": "2"})
+    with pytest.raises(ValueError, match="SPMD"):
+        fw.am_adapter().validate_and_update_config(conf)
+
+
+def test_mxnet_env():
+    spec = {"scheduler": ["h0:9100"], "server": ["h0:9101"],
+            "worker": ["h1:9102", "h1:9103"]}
+    env = get_framework("mxnet").task_adapter().build_task_env(
+        ctx_for("mxnet", "worker", 0, spec=spec,
+                conf_extra={"tony.scheduler.instances": "1",
+                            "tony.server.instances": "1",
+                            "tony.worker.instances": "2"}))
+    assert env[constants.ENV_DMLC_PS_ROOT_URI] == "h0"
+    assert env[constants.ENV_DMLC_PS_ROOT_PORT] == "9100"
+    assert env[constants.ENV_DMLC_ROLE] == "worker"
+    assert env[constants.ENV_DMLC_NUM_SERVER] == "1"
+    assert env[constants.ENV_DMLC_NUM_WORKER] == "2"
+
+
+def test_horovod_slot_math():
+    slots = compute_slots(["h0", "h0", "h1", "h1", "h1"])
+    assert [s["rank"] for s in slots] == [0, 1, 2, 3, 4]
+    assert [s["local_rank"] for s in slots] == [0, 1, 0, 1, 2]
+    assert [s["cross_rank"] for s in slots] == [0, 0, 1, 1, 1]
+    assert slots[0]["local_size"] == 2 and slots[4]["local_size"] == 3
+    assert all(s["size"] == 5 and s["cross_size"] == 2 for s in slots)
+
+
+def test_horovod_env_and_driver_roundtrip():
+    driver = HorovodDriver()
+    try:
+        payload = fetch_slots(driver.address)
+        assert payload["ready"] is False
+        driver.set_hosts(["h0", "h0", "h1", "h1"])
+        payload = fetch_slots(driver.address)
+        assert payload["ready"] and len(payload["slots"]) == 4
+
+        env = get_framework("horovod").task_adapter().build_task_env(
+            ctx_for("horovod", "worker", 1,
+                    callback={CALLBACK_RENDEZVOUS_ADDR: driver.address}))
+        assert env[constants.ENV_HOROVOD_RANK] == "2"
+        assert env[constants.ENV_HOROVOD_SIZE] == "4"
+        assert env[constants.ENV_HOROVOD_LOCAL_RANK] == "0"
+        assert env[constants.ENV_HOROVOD_CROSS_RANK] == "1"
+        assert env[constants.ENV_HOROVOD_RENDEZVOUS_PORT] == str(driver.port)
+        # NCCL→ICI bridge: coordinator triple present for the JAX data plane.
+        assert env[constants.ENV_COORDINATOR_ADDRESS] == "h0:4000"
+    finally:
+        driver.stop()
+
+
+def test_tb_port_reservation_policy():
+    ad = get_framework("jax").task_adapter()
+    assert ad.need_reserve_tb_port(ctx_for("jax", "chief", 0))
+    assert not ad.need_reserve_tb_port(ctx_for("jax", "worker", 0))
+    # With a dedicated tensorboard task, the chief does not reserve.
+    spec = dict(SPEC, tensorboard=["h9:5000"])
+    assert not ad.need_reserve_tb_port(
+        ctx_for("jax", "chief", 0, spec=spec,
+                conf_extra={"tony.tensorboard.instances": "1"}))

@@ -1,0 +1,122 @@
+"""Session state-machine tests (reference tier: TestTonySession).
+
+The success-policy matrix (SURVEY.md §7 hard part #2) is the point of these.
+"""
+
+import pytest
+
+from tony_tpu.conf import TonyConfig
+from tony_tpu.session import JobStatus, TaskStatus, TonySession
+
+
+def make_session(**props):
+    base = {"tony.worker.instances": "2"}
+    base.update({k: str(v) for k, v in props.items()})
+    return TonySession(TonyConfig(base), app_id="app_1_0001")
+
+
+def register_all(s: TonySession, port_base=4000):
+    i = 0
+    for t in s.tasks():
+        s.on_registered(t.job_type, t.index, "127.0.0.1", port_base + i)
+        i += 1
+    s.on_running()
+
+
+def test_gang_barrier_and_cluster_spec():
+    s = make_session(**{"tony.ps.instances": "1"})
+    assert not s.all_registered()
+    s.on_registered("worker", 0, "hostA", 4000)
+    s.on_registered("worker", 1, "hostB", 4001)
+    assert not s.all_registered()
+    s.on_registered("ps", 0, "hostC", 4002)
+    assert s.all_registered()
+    spec = s.cluster_spec()
+    assert spec == {"ps": ["hostC:4002"], "worker": ["hostA:4000", "hostB:4001"]}
+
+
+def test_all_workers_succeed():
+    s = make_session()
+    register_all(s)
+    s.on_task_result("worker", 0, 0)
+    assert s.job_status == JobStatus.RUNNING
+    s.on_task_result("worker", 1, 0)
+    assert s.job_status == JobStatus.SUCCEEDED
+
+
+def test_fail_fast_on_first_tracked_failure():
+    s = make_session()
+    register_all(s)
+    s.on_task_result("worker", 1, 42, "boom")
+    assert s.job_status == JobStatus.FAILED
+    assert "worker:1" in s.final_message
+
+
+def test_no_fail_fast_waits_for_all():
+    s = make_session(**{"tony.application.fail-fast": "false"})
+    register_all(s)
+    s.on_task_result("worker", 0, 1)
+    assert s.job_status == JobStatus.RUNNING     # still waiting for worker:1
+    s.on_task_result("worker", 1, 0)
+    assert s.job_status == JobStatus.FAILED      # but one failure fails the job
+
+
+def test_untracked_failure_ignored():
+    s = make_session(**{"tony.ps.instances": "1"})   # ps untracked by default
+    register_all(s)
+    s.on_task_result("ps", 0, 137, "ps crash")
+    assert s.job_status == JobStatus.RUNNING
+    s.on_task_result("worker", 0, 0)
+    s.on_task_result("worker", 1, 0)
+    assert s.job_status == JobStatus.SUCCEEDED
+    killed = s.kill_remaining("job done")          # untracked teardown
+    assert killed == []                            # ps already terminal
+
+
+def test_chief_done_policy():
+    s = make_session(**{"tony.chief.instances": "1"})
+    register_all(s)
+    s.on_task_result("chief", 0, 0)
+    # Chief success ends the job even with workers still running.
+    assert s.job_status == JobStatus.SUCCEEDED
+    assert s.kill_remaining("chief done")          # workers get torn down
+    assert all(t.status == TaskStatus.KILLED
+               for t in s.tasks() if t.job_type == "worker")
+
+
+def test_chief_failure_fails_job():
+    s = make_session(**{"tony.chief.instances": "1"})
+    register_all(s)
+    s.on_task_result("chief", 0, 3, "chief oom")
+    assert s.job_status == JobStatus.FAILED
+
+
+def test_lost_task_fails_job():
+    s = make_session()
+    register_all(s)
+    t = s.task("worker", 0)
+    s.on_task_lost(t, "missed 25 heartbeats")
+    assert t.status == TaskStatus.LOST
+    assert s.job_status == JobStatus.FAILED
+    assert "LOST" in s.final_message
+
+
+def test_global_rank_dense_and_stable():
+    s = make_session(**{"tony.chief.instances": "1", "tony.ps.instances": "2"})
+    # Order: chief-like first, then alphabetical: chief, ps, worker
+    assert s.global_rank("chief", 0) == 0
+    assert s.global_rank("ps", 0) == 1
+    assert s.global_rank("ps", 1) == 2
+    assert s.global_rank("worker", 0) == 3
+    assert s.global_rank("worker", 1) == 4
+    with pytest.raises(KeyError):
+        s.global_rank("worker", 5)
+
+
+def test_terminal_result_is_idempotent():
+    s = make_session()
+    register_all(s)
+    s.on_task_result("worker", 0, 1)
+    s.on_task_result("worker", 0, 0)   # late duplicate must not flip status
+    assert s.task("worker", 0).exit_code == 1
+    assert s.job_status == JobStatus.FAILED

@@ -1,0 +1,35 @@
+"""TonY-TPU: a TPU-native distributed-training orchestrator.
+
+A brand-new framework with the capabilities of TonY (linkedin/TonY fork
+claudiavmbrito/TonY): a client/CLI that packages and submits training jobs, an
+application-master-style scheduler that gang-allocates TPU hosts as containers,
+per-container task executors that wire framework rendezvous and launch user
+code, a pluggable framework-runtime SPI (TF ``TF_CONFIG``, PyTorch DDP, a
+Horovod-semantics adapter, and a first-class ``JAXRuntime`` driving
+``jax.distributed.initialize`` and XLA collectives over ICI/DCN), heartbeat
+failure detection with gang restart, an event-log-backed history server, and an
+in-process "MiniPod" cluster for distributed tests without real hardware.
+
+Reference parity map (upstream paths, see SURVEY.md; the reference mount was
+empty so citations are upstream-relative, class-level):
+
+==========================================  =========================================
+Reference (Java)                            This package (Python/JAX/C-ext)
+==========================================  =========================================
+tony-core TonyConfigurationKeys             tony_tpu.conf
+tony-core TonySession / TonyTask            tony_tpu.session
+tony-core rpc/* (Hadoop RPC + protobuf)     tony_tpu.rpc (gRPC, JSON wire)
+tony-core TaskExecutor / TaskMonitor        tony_tpu.executor
+tony-core TonyApplicationMaster             tony_tpu.am
+tony-core Framework SPI + runtime/*         tony_tpu.runtime
+tony-core events/* (Avro jhist)             tony_tpu.events (JSONL jhist)
+tony-core TonyClient                        tony_tpu.client
+tony-cli ClusterSubmitter/NotebookSubmitter tony_tpu.cli
+tony-history-server (Play portal)           tony_tpu.history
+tony-proxy ProxyServer                      tony_tpu.proxy
+tony-mini (docker pseudo-cluster)           tony_tpu.minipod (in-process)
+(no reference analogue; TPU compute plane)  tony_tpu.models / ops / parallel / train
+==========================================  =========================================
+"""
+
+__version__ = "0.1.0"

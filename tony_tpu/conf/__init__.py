@@ -1,0 +1,301 @@
+"""Layered job configuration with open per-jobtype templating.
+
+Mirrors ``com.linkedin.tony.TonyConfigurationKeys`` +
+``tony-core/src/main/resources/tony-default.xml`` (upstream paths, unverified —
+SURVEY.md §0).  The single most load-bearing idea preserved from the reference
+(SURVEY.md §5.6) is the *open* per-jobtype key template::
+
+    tony.<jobtype>.instances / .memory / .vcores / .gpus / .tpus / .command
+
+so that ``ps``/``worker``/``chief``/``evaluator``/``tensorboard``/``notebook``
+— or any user-invented job type — work without code changes.
+
+Layering (lowest to highest precedence), as in Hadoop ``Configuration``:
+
+1. built-in defaults (:data:`DEFAULTS`, the ``tony-default.xml`` analogue)
+2. a user config file — Hadoop-style ``tony.xml`` or JSON — via :meth:`TonyConfig.load`
+3. explicit ``-D key=value`` overrides via :meth:`TonyConfig.set`
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import xml.etree.ElementTree as ET
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from tony_tpu import constants
+
+# --------------------------------------------------------------------------
+# Key names (reference: TonyConfigurationKeys.*)
+# --------------------------------------------------------------------------
+TONY_PREFIX = "tony."
+
+APPLICATION_NAME = "tony.application.name"
+APPLICATION_FRAMEWORK = "tony.application.framework"          # jax|tensorflow|pytorch|horovod|mxnet|standalone
+APPLICATION_UNTRACKED = "tony.application.untracked.jobtypes" # csv of untracked types
+APPLICATION_STOP_ON_FAILURE = "tony.application.fail-fast"    # fail job on first task failure
+APPLICATION_TIMEOUT = "tony.application.timeout-ms"           # 0 = no timeout
+APPLICATION_NODE_BLACKLIST = "tony.application.node-blacklist"
+SECURITY_ENABLED = "tony.security.enabled"
+DOCKER_ENABLED = "tony.docker.enabled"
+DOCKER_IMAGE = "tony.docker.containers.image"
+
+TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
+TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
+TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
+TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.executor.execution-timeout-ms"
+
+AM_RETRY_COUNT = "tony.am.retry-count"                        # gang-restart attempts
+AM_MEMORY = "tony.am.memory"
+AM_VCORES = "tony.am.vcores"
+AM_GANG_TIMEOUT_MS = "tony.am.gang-allocation-timeout-ms"     # all-registered barrier timeout
+
+CONTAINER_ALLOCATION_TIMEOUT_MS = "tony.container.allocation-timeout-ms"
+PREEMPTION_MAX_RETRIES = "tony.container.preemption.max-retries"
+
+HISTORY_LOCATION = "tony.history.location"                    # event-log root dir
+KEYTAB_USER = "tony.keytab.user"                              # accepted, unused (no Kerberos)
+
+# Per-jobtype templates (reference: tony.{jobtype}.{instances,memory,vcores,gpus})
+def instances_key(job_type: str) -> str:
+    return f"tony.{job_type}.instances"
+
+def memory_key(job_type: str) -> str:
+    return f"tony.{job_type}.memory"
+
+def vcores_key(job_type: str) -> str:
+    return f"tony.{job_type}.vcores"
+
+def gpus_key(job_type: str) -> str:
+    return f"tony.{job_type}.gpus"
+
+def tpus_key(job_type: str) -> str:
+    return f"tony.{job_type}.tpus"          # TPU-native addition: chips per task
+
+def command_key(job_type: str) -> str:
+    return f"tony.{job_type}.command"       # per-jobtype command override
+
+def env_key(job_type: str) -> str:
+    return f"tony.{job_type}.env"           # csv KEY=VALUE extra env
+
+_INSTANCES_RE = re.compile(r"^tony\.([A-Za-z0-9_\-]+)\.instances$")
+# Keys of the form tony.<word>.instances that are NOT job types.
+_RESERVED_SEGMENTS = {"application", "task", "am", "container", "history",
+                      "docker", "security", "keytab"}
+
+DEFAULTS: Dict[str, str] = {
+    APPLICATION_NAME: "tony-tpu-job",
+    APPLICATION_FRAMEWORK: "jax",
+    APPLICATION_UNTRACKED: f"{constants.PS},{constants.TENSORBOARD},{constants.NOTEBOOK},{constants.DRIVER},{constants.SCHEDULER}",
+    APPLICATION_STOP_ON_FAILURE: "true",
+    APPLICATION_TIMEOUT: "0",
+    SECURITY_ENABLED: "false",
+    DOCKER_ENABLED: "false",
+    TASK_HEARTBEAT_INTERVAL_MS: "1000",
+    TASK_MAX_MISSED_HEARTBEATS: "25",
+    TASK_METRICS_INTERVAL_MS: "5000",
+    TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "0",
+    AM_RETRY_COUNT: "0",
+    AM_MEMORY: "2g",
+    AM_VCORES: "1",
+    AM_GANG_TIMEOUT_MS: "120000",
+    CONTAINER_ALLOCATION_TIMEOUT_MS: "120000",
+    PREEMPTION_MAX_RETRIES: "3",
+    HISTORY_LOCATION: "",
+}
+
+
+def _parse_memory(value: str) -> int:
+    """Parse '2g'/'512m'/'1024' (MiB) into MiB, as the reference's resource parser does."""
+    v = value.strip().lower()
+    if v.endswith("g"):
+        return int(float(v[:-1]) * 1024)
+    if v.endswith("m"):
+        return int(float(v[:-1]))
+    return int(v)
+
+
+class TonyConfig:
+    """Layered string-keyed configuration (Hadoop ``Configuration`` analogue)."""
+
+    def __init__(self, initial: Optional[Dict[str, str]] = None):
+        self._props: Dict[str, str] = dict(DEFAULTS)
+        if initial:
+            for k, v in initial.items():
+                self._props[k] = str(v)
+
+    # -- loading ------------------------------------------------------------
+    @classmethod
+    def load(cls, path: str | Path) -> "TonyConfig":
+        """Load a config file on top of defaults. ``.xml`` is parsed as a
+        Hadoop-style ``<configuration><property><name>..<value>..`` document
+        (``tony.xml`` compatibility); anything else is parsed as JSON."""
+        cfg = cls()
+        cfg.merge_file(path)
+        return cfg
+
+    def merge_file(self, path: str | Path) -> None:
+        path = Path(path)
+        if path.suffix == ".xml":
+            root = ET.parse(path).getroot()
+            for prop in root.iter("property"):
+                name = prop.findtext("name")
+                value = prop.findtext("value")
+                if name is not None and value is not None:
+                    self._props[name.strip()] = value.strip()
+        else:
+            data = json.loads(path.read_text())
+            if not isinstance(data, dict):
+                raise ValueError(f"config file {path} must hold a JSON object")
+            for k, v in data.items():
+                self._props[str(k)] = str(v)
+
+    def merge_overrides(self, overrides: Dict[str, str]) -> None:
+        """Apply ``-D key=value`` style overrides (highest precedence)."""
+        for k, v in overrides.items():
+            self._props[str(k)] = str(v)
+
+    # -- typed getters ------------------------------------------------------
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._props.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self._props.get(key)
+        return int(v) if v not in (None, "") else default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self._props.get(key)
+        return float(v) if v not in (None, "") else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._props.get(key)
+        if v is None or v == "":
+            return default
+        return v.strip().lower() in ("true", "1", "yes", "on")
+
+    def get_list(self, key: str, default: Tuple[str, ...] = ()) -> List[str]:
+        v = self._props.get(key)
+        if not v:
+            return list(default)
+        return [item.strip() for item in v.split(",") if item.strip()]
+
+    def get_memory_mb(self, key: str, default: str = "1g") -> int:
+        return _parse_memory(self._props.get(key) or default)
+
+    def set(self, key: str, value: Any) -> None:
+        self._props[key] = str(value)
+
+    def unset(self, key: str) -> None:
+        self._props.pop(key, None)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._props
+
+    def items(self) -> Iterator[Tuple[str, str]]:
+        return iter(sorted(self._props.items()))
+
+    # -- job-type discovery (the open templating) ---------------------------
+    def job_types(self) -> List[str]:
+        """All configured job types: every ``tony.<type>.instances`` key with a
+        positive count, excluding reserved segments. Order is deterministic:
+        chief-like first, then alphabetical (matches the reference's stable
+        cluster-spec assembly)."""
+        found = []
+        for key in self._props:
+            m = _INSTANCES_RE.match(key)
+            if not m:
+                continue
+            jt = m.group(1)
+            if jt in _RESERVED_SEGMENTS:
+                continue
+            if self.get_int(key, 0) > 0:
+                found.append(jt)
+        chief_like = [t for t in found if t in constants.CHIEF_LIKE_JOB_TYPES]
+        rest = sorted(t for t in found if t not in constants.CHIEF_LIKE_JOB_TYPES)
+        return chief_like + rest
+
+    def instances(self, job_type: str) -> int:
+        return self.get_int(instances_key(job_type), 0)
+
+    def total_tasks(self) -> int:
+        return sum(self.instances(t) for t in self.job_types())
+
+    def untracked_job_types(self) -> List[str]:
+        return self.get_list(APPLICATION_UNTRACKED)
+
+    def is_tracked(self, job_type: str) -> bool:
+        return job_type not in self.untracked_job_types()
+
+    def task_env(self, job_type: str) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for pair in self.get_list(env_key(job_type)):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                out[k] = v
+        return out
+
+    def container_request(self, job_type: str) -> "ContainerRequest":
+        return ContainerRequest(
+            job_type=job_type,
+            instances=self.instances(job_type),
+            memory_mb=self.get_memory_mb(memory_key(job_type), "1g"),
+            vcores=self.get_int(vcores_key(job_type), 1),
+            gpus=self.get_int(gpus_key(job_type), 0),
+            tpus=self.get_int(tpus_key(job_type), 0),
+        )
+
+    # -- validation (reference: TonyClient#init sanity checks) -------------
+    def validate(self) -> None:
+        if not self.job_types():
+            raise ValueError(
+                "no job types configured: set at least one tony.<jobtype>.instances > 0")
+        for jt in self.job_types():
+            n = self.instances(jt)
+            if n < 0:
+                raise ValueError(f"{instances_key(jt)} must be >= 0, got {n}")
+            if self.get_int(vcores_key(jt), 1) <= 0:
+                raise ValueError(f"{vcores_key(jt)} must be > 0")
+        framework = self.get(APPLICATION_FRAMEWORK, "jax")
+        from tony_tpu.runtime import FRAMEWORKS  # late import: avoid cycle
+        if framework not in FRAMEWORKS:
+            raise ValueError(
+                f"unknown {APPLICATION_FRAMEWORK}={framework!r}; "
+                f"known: {sorted(FRAMEWORKS)}")
+
+    # -- serialization (ship effective conf to AM / executors) -------------
+    def to_json(self) -> str:
+        return json.dumps(self._props, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "TonyConfig":
+        cfg = cls()
+        cfg._props.update({str(k): str(v) for k, v in json.loads(text).items()})
+        return cfg
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_json())
+
+
+class ContainerRequest:
+    """Resource ask for one job type (reference: ``JobContainerRequest``)."""
+
+    __slots__ = ("job_type", "instances", "memory_mb", "vcores", "gpus", "tpus")
+
+    def __init__(self, job_type: str, instances: int, memory_mb: int,
+                 vcores: int, gpus: int, tpus: int):
+        self.job_type = job_type
+        self.instances = instances
+        self.memory_mb = memory_mb
+        self.vcores = vcores
+        self.gpus = gpus
+        self.tpus = tpus
+
+    def __repr__(self) -> str:
+        return (f"ContainerRequest({self.job_type}x{self.instances}, "
+                f"{self.memory_mb}MiB, {self.vcores}c, gpus={self.gpus}, tpus={self.tpus})")
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ContainerRequest) and all(
+            getattr(self, f) == getattr(other, f) for f in self.__slots__)

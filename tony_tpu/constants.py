@@ -1,0 +1,100 @@
+"""Shared constants: env-var names, file names, well-known job types.
+
+Mirrors the role of ``com.linkedin.tony.Constants`` (tony-core, upstream path
+``tony-core/src/main/java/com/linkedin/tony/Constants.java``, unverified — see
+SURVEY.md §0): the single place where the env-var contract between the AM, the
+task executors, and user code is written down.
+"""
+
+# --- Environment contract: AM -> TaskExecutor -------------------------------
+# (reference: Constants.JOB_NAME / TASK_INDEX / AM_HOST / AM_PORT etc., set in
+#  TonyApplicationMaster#buildContainerLaunchContext)
+ENV_JOB_NAME = "TONY_JOB_NAME"              # jobtype, e.g. "worker", "ps", "chief"
+ENV_TASK_INDEX = "TONY_TASK_INDEX"          # integer index within the jobtype
+ENV_TASK_NUM = "TONY_NUM_TASKS"             # total number of tasks in the job
+ENV_AM_ADDRESS = "TONY_AM_ADDRESS"          # host:port of the AM ApplicationRpc
+ENV_APP_ID = "TONY_APP_ID"                  # application id, e.g. "app_1700000000_0001"
+ENV_ATTEMPT_ID = "TONY_ATTEMPT_ID"          # AM attempt ordinal (gang restart)
+ENV_CONF_PATH = "TONY_CONF_PATH"            # path to the serialized job config
+ENV_CONTAINER_ID = "TONY_CONTAINER_ID"      # container id for this executor
+ENV_LOG_DIR = "TONY_LOG_DIR"                # directory for executor+user logs
+ENV_SRC_DIR = "TONY_SRC_DIR"                # localized user source directory
+ENV_VENV = "TONY_VENV"                      # localized virtualenv (optional)
+
+# --- Environment contract: TaskExecutor -> user process ---------------------
+# (reference: MLGenericRuntime common env + per-runtime additions)
+ENV_JOB_TYPE = "JOB_NAME"                   # TonY exports JOB_NAME/TASK_INDEX too
+ENV_TASK_INDEX_USER = "TASK_INDEX"
+ENV_DIST_SPEC = "CLUSTER_SPEC"              # JSON {jobtype: ["host:port", ...]}
+ENV_TB_PORT = "TB_PORT"                     # reserved TensorBoard port (chief/tb)
+
+# JAXRuntime rendezvous (the north-star JAX path; consumed by
+# tony_tpu.distributed.initialize() and by jax.distributed directly)
+ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "TONY_PROCESS_ID"
+ENV_NUM_PROCESSES = "TONY_NUM_PROCESSES"
+ENV_LOCAL_DEVICE_IDS = "TONY_LOCAL_DEVICE_IDS"
+
+# TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
+ENV_TF_CONFIG = "TF_CONFIG"
+ENV_MASTER_ADDR = "MASTER_ADDR"
+ENV_MASTER_PORT = "MASTER_PORT"
+ENV_RANK = "RANK"
+ENV_WORLD_SIZE = "WORLD_SIZE"
+ENV_LOCAL_RANK = "LOCAL_RANK"
+ENV_INIT_METHOD = "INIT_METHOD"
+ENV_HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+ENV_HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+ENV_HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+ENV_HOROVOD_RANK = "HOROVOD_RANK"
+ENV_HOROVOD_SIZE = "HOROVOD_SIZE"
+ENV_HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+ENV_HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+ENV_HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+ENV_HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+ENV_DMLC_PS_ROOT_URI = "DMLC_PS_ROOT_URI"
+ENV_DMLC_PS_ROOT_PORT = "DMLC_PS_ROOT_PORT"
+ENV_DMLC_ROLE = "DMLC_ROLE"
+ENV_DMLC_NUM_SERVER = "DMLC_NUM_SERVER"
+ENV_DMLC_NUM_WORKER = "DMLC_NUM_WORKER"
+
+# TPU topology env injected by JAXRuntime on real pods (libtpu contract)
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"
+ENV_TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+
+# --- Well-known job types ---------------------------------------------------
+# (reference: open-ended; these are the conventional names used by the success
+#  policy in TonyApplicationMaster / TonySession)
+CHIEF = "chief"
+MASTER = "master"
+PS = "ps"
+WORKER = "worker"
+EVALUATOR = "evaluator"
+TENSORBOARD = "tensorboard"
+NOTEBOOK = "notebook"
+DRIVER = "driver"               # Horovod-style driver task
+SCHEDULER = "scheduler"         # MXNet kvstore scheduler
+
+# Job types whose completion drives the "chief done => job done" policy.
+CHIEF_LIKE_JOB_TYPES = (CHIEF, MASTER)
+
+# --- File-layout conventions ------------------------------------------------
+TONY_XML = "tony.xml"                       # user config file name (compat)
+TONY_JOB_JSON = "tony-job.json"             # serialized effective config
+JHIST_SUFFIX = ".jhist"                     # history file (JSONL here, Avro in ref)
+JHIST_INPROGRESS_SUFFIX = ".jhist.inprogress"
+EVENTS_DIR_INTERMEDIATE = "intermediate"    # AM writes here while running
+EVENTS_DIR_FINISHED = "finished"            # moved here on completion
+EXECUTOR_LOG_NAME = "executor.log"
+USER_STDOUT_NAME = "stdout.log"
+USER_STDERR_NAME = "stderr.log"
+
+# --- Exit codes (reference: TaskExecutor / TonyClient contract) -------------
+EXIT_SUCCESS = 0
+EXIT_FAILURE = 1
+EXIT_AM_ERROR = 10          # AM internal error
+EXIT_LOST_TASK = 11         # task lost to missed heartbeats
+EXIT_PREEMPTED = 12         # container preempted by the scheduler
+EXIT_KILLED = 13            # killed by client / untracked-task teardown

@@ -1,0 +1,63 @@
+"""User-facing rendezvous helper for JAX jobs launched by TonY-TPU.
+
+The JAXRuntime exports the coordinator triple (SURVEY.md §2.4 "rendezvous");
+user code simply calls::
+
+    import tony_tpu.distributed as dist
+    dist.initialize()          # no-op outside a TonY job or for 1 process
+
+which forwards to ``jax.distributed.initialize(coordinator_address,
+num_processes, process_id)`` — the TPU-native replacement for ``TF_CONFIG`` /
+c10d / Gloo rendezvous.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from tony_tpu import constants
+
+
+def env_spec() -> Optional[tuple[str, int, int]]:
+    """(coordinator_address, num_processes, process_id) from the executor env,
+    or None when not running under TonY-TPU."""
+    addr = os.environ.get(constants.ENV_COORDINATOR_ADDRESS)
+    n = os.environ.get(constants.ENV_NUM_PROCESSES)
+    pid = os.environ.get(constants.ENV_PROCESS_ID)
+    if not addr or n is None or pid is None:
+        return None
+    return addr, int(n), int(pid)
+
+
+def initialize(local_device_ids: Optional[Sequence[int]] = None) -> bool:
+    """Bring up the JAX coordination service from TonY env. Returns True if
+    multi-process init happened, False for the single-process fallback."""
+    spec = env_spec()
+    if spec is None:
+        return False
+    addr, num_processes, process_id = spec
+    if num_processes <= 1:
+        return False
+    import jax
+    if local_device_ids is None:
+        raw = os.environ.get(constants.ENV_LOCAL_DEVICE_IDS)
+        if raw:
+            local_device_ids = [int(x) for x in raw.split(",")]
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
+def process_id() -> int:
+    spec = env_spec()
+    return spec[2] if spec else 0
+
+
+def num_processes() -> int:
+    spec = env_spec()
+    return spec[1] if spec else 1

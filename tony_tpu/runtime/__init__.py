@@ -1,0 +1,184 @@
+"""Framework-runtime SPI: the extension point the whole framework pivots on.
+
+Mirrors ``com.linkedin.tony.Framework`` (nested ``ApplicationMasterAdapter`` /
+``TaskExecutorAdapter``) + ``FrameworkType`` (upstream ``tony-core/src/main/
+java/com/linkedin/tony/Framework.java``, unverified — SURVEY.md §0).
+
+Each supported ML framework contributes two adapters:
+
+* an **AM-side adapter** — config validation, start gating (e.g. the Horovod
+  rendezvous driver must be up before workers may launch), task callbacks;
+* an **executor-side adapter** — builds the rendezvous env for the user
+  process (``TF_CONFIG``, ``MASTER_ADDR``…, ``HOROVOD_*``, ``DMLC_*``, or the
+  JAX coordinator triple) from the assembled cluster spec.
+
+The first-class citizen here is :class:`~tony_tpu.runtime.jax_runtime.JAXRuntime`
+(the BASELINE.json north star): rendezvous is ``jax.distributed.initialize
+(coordinator_address, num_processes, process_id)`` and the data plane is XLA
+collectives over ICI/DCN — no NCCL anywhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tony_tpu.conf import TonyConfig
+    from tony_tpu.session import TonySession
+
+
+@dataclass
+class TaskContext:
+    """Everything an executor-side adapter may need to build the user env
+    (reference: the executor fields passed into ``buildTaskEnv``)."""
+    conf: "TonyConfig"
+    job_type: str
+    index: int
+    cluster_spec: Dict[str, List[str]]      # {job_type: ["host:port", ...]}
+    am_address: str
+    app_id: str
+    attempt_id: int = 1
+    tb_port: Optional[int] = None
+    callback_info: Dict[str, str] = field(default_factory=dict)  # AM-pushed extras
+
+    # -- derived helpers shared by adapters --------------------------------
+    def job_types(self) -> List[str]:
+        return self.conf.job_types()
+
+    def num_tasks(self) -> int:
+        return sum(len(v) for v in self.cluster_spec.values())
+
+    def global_rank(self) -> int:
+        """Dense rank over (job_types order, index) — must match
+        ``TonySession.global_rank``."""
+        rank = 0
+        for jt in self.job_types():
+            n = len(self.cluster_spec.get(jt, []))
+            if jt == self.job_type:
+                return rank + self.index
+            rank += n
+        raise KeyError(f"job type {self.job_type} not in cluster spec")
+
+    def spec_of(self, job_type: str, index: int) -> str:
+        members = self.cluster_spec.get(job_type, [])
+        if index >= len(members) or not members[index]:
+            raise KeyError(f"no spec for {job_type}:{index}")
+        return members[index]
+
+    def rank0_spec(self) -> str:
+        """host:port of the global-rank-0 task (the coordinator)."""
+        first_jt = self.job_types()[0]
+        return self.spec_of(first_jt, 0)
+
+    def host_of(self, job_type: str, index: int) -> str:
+        return self.spec_of(job_type, index).rsplit(":", 1)[0]
+
+    def my_host(self) -> str:
+        return self.host_of(self.job_type, self.index)
+
+    def local_rank(self) -> tuple[int, int]:
+        """(local_rank, local_size) among tasks sharing this task's host,
+        ordered by global rank — Horovod/PyTorch local-rank semantics."""
+        me = self.global_rank()
+        host = self.my_host()
+        cohort = []
+        rank = 0
+        for jt in self.job_types():
+            for i, spec in enumerate(self.cluster_spec.get(jt, [])):
+                if spec and spec.rsplit(":", 1)[0] == host:
+                    cohort.append(rank)
+                rank += 1
+        cohort.sort()
+        return cohort.index(me), len(cohort)
+
+
+class TaskExecutorAdapter:
+    """Executor-side SPI (reference: ``Framework.TaskExecutorAdapter``)."""
+
+    def need_reserve_tb_port(self, ctx: TaskContext) -> bool:
+        """Whether this task should reserve a TensorBoard port (chief or a
+        dedicated ``tensorboard`` task)."""
+        from tony_tpu import constants
+        return ctx.job_type in (constants.TENSORBOARD,) or (
+            ctx.job_type in constants.CHIEF_LIKE_JOB_TYPES and
+            constants.TENSORBOARD not in ctx.job_types())
+
+    def build_task_env(self, ctx: TaskContext) -> Dict[str, str]:
+        """Rendezvous env for the user process. Subclasses extend."""
+        raise NotImplementedError
+
+    def validate(self, ctx: TaskContext) -> None:
+        """Pre-launch sanity hook (default: none)."""
+
+
+class ApplicationMasterAdapter:
+    """AM-side SPI (reference: ``Framework.ApplicationMasterAdapter``)."""
+
+    def set_session(self, session: "TonySession") -> None:
+        self.session = session
+
+    def validate_and_update_config(self, conf: "TonyConfig") -> None:
+        """Framework-specific config validation/defaulting (AM start)."""
+
+    def can_start_task(self, job_type: str, index: int) -> bool:
+        """Gate container launches (e.g. Horovod: driver must be ready)."""
+        return True
+
+    def on_all_registered(self) -> None:
+        """Called once when the gang barrier passes — adapters that need a
+        global view (Horovod slot assignment) compute it here."""
+
+    def callback_info(self) -> Dict[str, str]:
+        """Extra key/values shipped to every executor with the cluster spec
+        (e.g. the Horovod rendezvous address)."""
+        return {}
+
+    def receive_task_callback_info(self, task_id: str, payload: str) -> None:
+        """Executor-pushed framework-specific info (reference RPC of the
+        same name)."""
+
+    def stop(self) -> None:
+        """Tear down AM-side resources (rendezvous drivers etc.)."""
+
+
+class Framework:
+    """One supported framework: a name plus its two adapter factories."""
+
+    name: str = "abstract"
+
+    def am_adapter(self) -> ApplicationMasterAdapter:
+        return ApplicationMasterAdapter()
+
+    def task_adapter(self) -> TaskExecutorAdapter:
+        raise NotImplementedError
+
+
+def _registry() -> Dict[str, Framework]:
+    from tony_tpu.runtime.jax_runtime import JAXFramework
+    from tony_tpu.runtime.tf_runtime import TFFramework
+    from tony_tpu.runtime.pytorch_runtime import PyTorchFramework
+    from tony_tpu.runtime.horovod_runtime import HorovodFramework
+    from tony_tpu.runtime.mxnet_runtime import MXNetFramework
+    from tony_tpu.runtime.standalone import StandaloneFramework
+    fws = [JAXFramework(), TFFramework(), PyTorchFramework(),
+           HorovodFramework(), MXNetFramework(), StandaloneFramework()]
+    return {f.name: f for f in fws}
+
+
+FRAMEWORKS: Dict[str, "Framework"] = {}
+
+
+def get_framework(name: str) -> Framework:
+    """Look up a framework by ``tony.application.framework`` value
+    (reference: ``Framework.of(FrameworkType)``)."""
+    if not FRAMEWORKS:
+        FRAMEWORKS.update(_registry())
+    try:
+        return FRAMEWORKS[name]
+    except KeyError:
+        raise ValueError(f"unknown framework {name!r}; known: {sorted(FRAMEWORKS)}")
+
+
+# Populate eagerly so `name in FRAMEWORKS` works for conf.validate().
+FRAMEWORKS.update(_registry())

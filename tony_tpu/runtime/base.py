@@ -1,0 +1,39 @@
+"""Shared adapter base (reference: ``runtime/MLGenericRuntime.java``).
+
+Provides the common env every runtime exports — job name, task index, the full
+cluster spec, app metadata — plus per-jobtype extra env from
+``tony.<jobtype>.env``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from tony_tpu import constants
+from tony_tpu.runtime import TaskContext, TaskExecutorAdapter
+
+
+class MLGenericTaskAdapter(TaskExecutorAdapter):
+    """Common env builder; framework adapters extend :meth:`framework_env`."""
+
+    def build_task_env(self, ctx: TaskContext) -> Dict[str, str]:
+        env: Dict[str, str] = {
+            constants.ENV_JOB_TYPE: ctx.job_type,
+            constants.ENV_TASK_INDEX_USER: str(ctx.index),
+            constants.ENV_DIST_SPEC: json.dumps(ctx.cluster_spec, sort_keys=True),
+            constants.ENV_JOB_NAME: ctx.job_type,
+            constants.ENV_TASK_INDEX: str(ctx.index),
+            constants.ENV_TASK_NUM: str(ctx.num_tasks()),
+            constants.ENV_APP_ID: ctx.app_id,
+            constants.ENV_ATTEMPT_ID: str(ctx.attempt_id),
+            constants.ENV_AM_ADDRESS: ctx.am_address,
+        }
+        if ctx.tb_port is not None:
+            env[constants.ENV_TB_PORT] = str(ctx.tb_port)
+        env.update(ctx.conf.task_env(ctx.job_type))
+        env.update(self.framework_env(ctx))
+        return env
+
+    def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        return {}

@@ -1,0 +1,111 @@
+"""In-AM rendezvous driver for the Horovod-semantics runtime.
+
+The reference forks a Python Gloo ``RendezvousServer`` and line-parses its
+stdout for the port and slot assignments (``runtime/horovod/HorovodDriver.java``
+— SURVEY.md §3.4 calls it the most intricate runtime). Because our data plane
+is XLA-over-ICI rather than Gloo/NCCL, the driver here is a small in-process
+TCP server that serves the computed slot table as one JSON document per
+connection — same contract (workers can fetch global/local/cross ranks from a
+rendezvous address), no subprocess, no stdout parsing.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Dict, List, Optional
+
+
+def compute_slots(hosts: List[str]) -> List[Dict[str, int]]:
+    """Horovod slot assignment from the ordered per-rank host list:
+    ``rank`` = position, ``local_rank`` = index among same-host ranks,
+    ``cross_rank`` = index of this host among distinct hosts (host-major),
+    sizes to match."""
+    distinct: List[str] = []
+    for h in hosts:
+        if h not in distinct:
+            distinct.append(h)
+    local_counts: Dict[str, int] = {}
+    slots = []
+    for rank, host in enumerate(hosts):
+        local_rank = local_counts.get(host, 0)
+        local_counts[host] = local_rank + 1
+        slots.append({
+            "rank": rank,
+            "size": len(hosts),
+            "local_rank": local_rank,
+            "cross_rank": distinct.index(host),
+            "cross_size": len(distinct),
+        })
+    for s, host in zip(slots, hosts):
+        s["local_size"] = local_counts[host]
+    return slots
+
+
+class HorovodDriver:
+    """Serves the slot table as JSON to any connecting client."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._slots: Optional[List[Dict[str, int]]] = None
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="horovod-driver", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def set_hosts(self, hosts: List[str]) -> None:
+        with self._lock:
+            self._slots = compute_slots(hosts)
+
+    def slots(self) -> Optional[List[Dict[str, int]]]:
+        with self._lock:
+            return list(self._slots) if self._slots is not None else None
+
+    def _serve(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with self._lock:
+                    payload = {"ready": self._slots is not None,
+                               "slots": self._slots or []}
+                conn.sendall(json.dumps(payload).encode())
+            finally:
+                conn.close()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+
+def fetch_slots(address: str, timeout: float = 5.0) -> Dict[str, object]:
+    """Client side: fetch the slot table from a running driver."""
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        chunks = []
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+    return json.loads(b"".join(chunks).decode())

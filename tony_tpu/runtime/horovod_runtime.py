@@ -1,0 +1,95 @@
+"""Horovod-semantics runtime: ring-allreduce jobs on TPU (reference:
+``runtime/HorovodRuntime.java`` + ``runtime/horovod/HorovodDriver.java``).
+
+AM side: once the gang barrier passes (:meth:`on_all_registered`), the adapter
+computes Horovod slot assignments from the ordered per-rank host list and
+publishes them through an in-AM rendezvous server
+(:class:`~tony_tpu.runtime.horovod_driver.HorovodDriver`); the driver address
+ships to executors in the cluster-spec callback info.
+
+Executor side: exports the full ``HOROVOD_*`` env (controller, rendezvous
+addr/port, rank/size, local and cross ranks) — so user scripts written against
+``hvd.init()``-style APIs see the contract they expect. The data plane,
+though, is XLA ``psum`` over ICI (the NCCL→ICI replacement named in the north
+star): the coordinator triple is exported too, so the same job can run
+``tony_tpu.distributed.initialize()`` and use ``jax.lax.psum`` as its
+allreduce.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from tony_tpu import constants
+from tony_tpu.runtime import ApplicationMasterAdapter, Framework, TaskContext
+from tony_tpu.runtime.base import MLGenericTaskAdapter
+from tony_tpu.runtime.horovod_driver import HorovodDriver
+
+CALLBACK_RENDEZVOUS_ADDR = "horovod.rendezvous.address"
+
+
+class HorovodAMAdapter(ApplicationMasterAdapter):
+    def __init__(self) -> None:
+        self.driver: Optional[HorovodDriver] = None
+
+    def validate_and_update_config(self, conf) -> None:
+        self.driver = HorovodDriver()
+
+    def on_all_registered(self) -> None:
+        hosts = []
+        spec = self.session.cluster_spec()
+        for jt in self.session.conf.job_types():
+            for member in spec.get(jt, []):
+                hosts.append(member.rsplit(":", 1)[0])
+        assert self.driver is not None
+        self.driver.set_hosts(hosts)
+
+    def callback_info(self) -> Dict[str, str]:
+        assert self.driver is not None
+        return {CALLBACK_RENDEZVOUS_ADDR: self.driver.address}
+
+    def stop(self) -> None:
+        if self.driver is not None:
+            self.driver.stop()
+
+
+class HorovodTaskAdapter(MLGenericTaskAdapter):
+    def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        rank = ctx.global_rank()
+        n = ctx.num_tasks()
+        local_rank, local_size = ctx.local_rank()
+        # cross rank: index of this host among distinct hosts, host-major.
+        distinct = []
+        for jt in ctx.job_types():
+            for spec in ctx.cluster_spec.get(jt, []):
+                h = spec.rsplit(":", 1)[0]
+                if h not in distinct:
+                    distinct.append(h)
+        rendezvous = ctx.callback_info.get(CALLBACK_RENDEZVOUS_ADDR, "")
+        r_host, _, r_port = rendezvous.rpartition(":")
+        env = {
+            constants.ENV_HOROVOD_CONTROLLER: "tony",     # ref: "gloo"
+            constants.ENV_HOROVOD_RENDEZVOUS_ADDR: r_host,
+            constants.ENV_HOROVOD_RENDEZVOUS_PORT: r_port,
+            constants.ENV_HOROVOD_RANK: str(rank),
+            constants.ENV_HOROVOD_SIZE: str(n),
+            constants.ENV_HOROVOD_LOCAL_RANK: str(local_rank),
+            constants.ENV_HOROVOD_LOCAL_SIZE: str(local_size),
+            constants.ENV_HOROVOD_CROSS_RANK: str(distinct.index(ctx.my_host())),
+            constants.ENV_HOROVOD_CROSS_SIZE: str(len(distinct)),
+            # NCCL→ICI: same job can bring up the JAX data plane directly.
+            constants.ENV_COORDINATOR_ADDRESS: ctx.rank0_spec(),
+            constants.ENV_PROCESS_ID: str(rank),
+            constants.ENV_NUM_PROCESSES: str(n),
+        }
+        return env
+
+
+class HorovodFramework(Framework):
+    name = "horovod"
+
+    def am_adapter(self) -> HorovodAMAdapter:
+        return HorovodAMAdapter()
+
+    def task_adapter(self) -> HorovodTaskAdapter:
+        return HorovodTaskAdapter()

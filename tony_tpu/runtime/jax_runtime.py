@@ -1,0 +1,76 @@
+"""JAXRuntime — the first-class TPU-native runtime (BASELINE.json north star).
+
+Replaces the reference's NCCL rendezvous runtimes: the AM assigns roles, and
+this adapter wires ``jax.distributed.initialize(coordinator_address,
+num_processes, process_id)`` from them. The global-rank-0 task's registered
+host:port becomes the coordinator address (its executor reserved that port at
+registration, exactly like the reference's ServerSocket reservation in
+``TaskExecutor``). The data plane is XLA collectives (``psum`` /
+``all_gather`` / ``ppermute`` / ``reduce_scatter``) over ICI intra-slice and
+DCN across slices — there is no NCCL and no parameter server.
+
+On a real TPU pod the adapter additionally injects the libtpu topology env
+(``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``, chip pinning via
+``TPU_VISIBLE_DEVICES`` when ``tony.<jobtype>.tpus`` subdivides a host) so
+multiple tasks can share a host, each seeing only its chips.
+
+User code calls :func:`tony_tpu.distributed.initialize` (or passes the env
+straight to ``jax.distributed.initialize``) and then uses plain
+``jax.sharding`` meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tony_tpu import constants
+from tony_tpu.runtime import ApplicationMasterAdapter, Framework, TaskContext
+from tony_tpu.runtime.base import MLGenericTaskAdapter
+
+
+class JAXTaskAdapter(MLGenericTaskAdapter):
+    def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        coordinator = ctx.rank0_spec()
+        rank = ctx.global_rank()
+        n = ctx.num_tasks()
+        env = {
+            constants.ENV_COORDINATOR_ADDRESS: coordinator,
+            constants.ENV_PROCESS_ID: str(rank),
+            constants.ENV_NUM_PROCESSES: str(n),
+        }
+        tpus = ctx.conf.get_int(f"tony.{ctx.job_type}.tpus", 0)
+        if tpus > 0:
+            # Chip pinning: tasks sharing a host each see a disjoint chip set.
+            local_rank, _ = ctx.local_rank()
+            first = local_rank * tpus
+            chips = ",".join(str(first + i) for i in range(tpus))
+            env[constants.ENV_TPU_VISIBLE_DEVICES] = chips
+            env[constants.ENV_LOCAL_DEVICE_IDS] = chips
+        # libtpu multi-host topology (harmless off-pod; required on pods).
+        hosts = []
+        for jt in ctx.job_types():
+            for spec in ctx.cluster_spec.get(jt, []):
+                hosts.append(spec.rsplit(":", 1)[0] if spec else "")
+        env[constants.ENV_TPU_WORKER_ID] = str(rank)
+        env[constants.ENV_TPU_WORKER_HOSTNAMES] = ",".join(hosts)
+        return env
+
+
+class JAXAMAdapter(ApplicationMasterAdapter):
+    def validate_and_update_config(self, conf) -> None:
+        # JAX jobs are SPMD gangs: parameter-server job types make no sense.
+        for jt in conf.job_types():
+            if jt == constants.PS and conf.instances(jt) > 0:
+                raise ValueError(
+                    "framework=jax is SPMD: remove tony.ps.instances "
+                    "(parameters are sharded with the model, not served)")
+
+
+class JAXFramework(Framework):
+    name = "jax"
+
+    def am_adapter(self) -> JAXAMAdapter:
+        return JAXAMAdapter()
+
+    def task_adapter(self) -> JAXTaskAdapter:
+        return JAXTaskAdapter()

@@ -1,0 +1,44 @@
+"""MXNetRuntime: DMLC kvstore parameter-server env (reference:
+``runtime/MXNetRuntime.java``).
+
+MXNet jobs use job types ``scheduler`` (1), ``server`` (N), ``worker`` (M);
+every task gets the scheduler's root URI/port plus its own DMLC role.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tony_tpu import constants
+from tony_tpu.runtime import Framework, TaskContext
+from tony_tpu.runtime.base import MLGenericTaskAdapter
+
+_ROLE_MAP = {constants.SCHEDULER: "scheduler", "server": "server",
+             constants.PS: "server", constants.WORKER: "worker"}
+
+
+class MXNetTaskAdapter(MLGenericTaskAdapter):
+    def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        sched = ctx.spec_of(constants.SCHEDULER, 0)
+        host, _, port = sched.rpartition(":")
+        n_server = sum(len(ctx.cluster_spec.get(jt, []))
+                       for jt in ("server", constants.PS))
+        n_worker = len(ctx.cluster_spec.get(constants.WORKER, []))
+        return {
+            constants.ENV_DMLC_PS_ROOT_URI: host,
+            constants.ENV_DMLC_PS_ROOT_PORT: port,
+            constants.ENV_DMLC_ROLE: _ROLE_MAP.get(ctx.job_type, "worker"),
+            constants.ENV_DMLC_NUM_SERVER: str(n_server),
+            constants.ENV_DMLC_NUM_WORKER: str(n_worker),
+        }
+
+    def validate(self, ctx: TaskContext) -> None:
+        if constants.SCHEDULER not in ctx.cluster_spec:
+            raise ValueError("mxnet jobs require tony.scheduler.instances=1")
+
+
+class MXNetFramework(Framework):
+    name = "mxnet"
+
+    def task_adapter(self) -> MXNetTaskAdapter:
+        return MXNetTaskAdapter()

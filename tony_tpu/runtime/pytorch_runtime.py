@@ -1,0 +1,38 @@
+"""PyTorchRuntime: c10d TCP-store rendezvous env for ``torch.distributed``
+DDP (reference: ``runtime/PyTorchRuntime.java`` — ``buildTaskEnv``).
+
+Exports ``MASTER_ADDR``/``MASTER_PORT`` (the global-rank-0 task's registered
+host/port), ``RANK``, ``WORLD_SIZE``, ``LOCAL_RANK`` and ``INIT_METHOD`` so the
+user script's ``torch.distributed.init_process_group('gloo'|'nccl')`` — or,
+TPU-natively, ``torch_xla``'s xrt rendezvous — comes up with no code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tony_tpu import constants
+from tony_tpu.runtime import Framework, TaskContext
+from tony_tpu.runtime.base import MLGenericTaskAdapter
+
+
+class PyTorchTaskAdapter(MLGenericTaskAdapter):
+    def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        master = ctx.rank0_spec()
+        host, _, port = master.rpartition(":")
+        local_rank, _local_size = ctx.local_rank()
+        return {
+            constants.ENV_MASTER_ADDR: host,
+            constants.ENV_MASTER_PORT: port,
+            constants.ENV_RANK: str(ctx.global_rank()),
+            constants.ENV_WORLD_SIZE: str(ctx.num_tasks()),
+            constants.ENV_LOCAL_RANK: str(local_rank),
+            constants.ENV_INIT_METHOD: f"tcp://{master}",
+        }
+
+
+class PyTorchFramework(Framework):
+    name = "pytorch"
+
+    def task_adapter(self) -> PyTorchTaskAdapter:
+        return PyTorchTaskAdapter()

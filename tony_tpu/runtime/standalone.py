@@ -1,0 +1,19 @@
+"""StandaloneRuntime: no rendezvous env — single-task or embarrassingly
+parallel jobs, and the notebook path (reference:
+``runtime/StandaloneRuntime.java``)."""
+
+from __future__ import annotations
+
+from tony_tpu.runtime import Framework
+from tony_tpu.runtime.base import MLGenericTaskAdapter
+
+
+class StandaloneTaskAdapter(MLGenericTaskAdapter):
+    pass  # common env only
+
+
+class StandaloneFramework(Framework):
+    name = "standalone"
+
+    def task_adapter(self) -> StandaloneTaskAdapter:
+        return StandaloneTaskAdapter()

@@ -1,0 +1,42 @@
+"""TFRuntime: builds the ``TF_CONFIG`` JSON that drives ``tf.distribute``
+ParameterServerStrategy / MultiWorkerMirroredStrategy (reference:
+``runtime/TFRuntime.java`` — ``constructClusterSpec``/``buildTaskEnv``).
+
+``TF_CONFIG`` shape::
+
+    {"cluster": {"ps": [...], "worker": [...], "chief": [...]},
+     "task": {"type": "<job_type>", "index": <i>}}
+
+The cluster section contains only the ML job types (tensorboard/notebook and
+other sidecar types are excluded, as in the reference).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from tony_tpu import constants
+from tony_tpu.runtime import Framework, TaskContext
+from tony_tpu.runtime.base import MLGenericTaskAdapter
+
+# Sidecar types never included in the TF cluster spec.
+_NON_CLUSTER_TYPES = {constants.TENSORBOARD, constants.NOTEBOOK, constants.DRIVER}
+
+
+class TFTaskAdapter(MLGenericTaskAdapter):
+    def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        cluster = {jt: members for jt, members in ctx.cluster_spec.items()
+                   if jt not in _NON_CLUSTER_TYPES and members}
+        tf_config = {
+            "cluster": cluster,
+            "task": {"type": ctx.job_type, "index": ctx.index},
+        }
+        return {constants.ENV_TF_CONFIG: json.dumps(tf_config, sort_keys=True)}
+
+
+class TFFramework(Framework):
+    name = "tensorflow"
+
+    def task_adapter(self) -> TFTaskAdapter:
+        return TFTaskAdapter()

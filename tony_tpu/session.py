@@ -1,0 +1,305 @@
+"""In-AM job state: task registry, cluster-spec assembly, success policy.
+
+Mirrors ``com.linkedin.tony.TonySession`` / ``TonySession.TonyTask`` /
+``TaskStatus`` (upstream ``tony-core/src/main/java/com/linkedin/tony/
+TonySession.java``, unverified — SURVEY.md §0).  The subtle part carried over
+faithfully is the **success-policy matrix** (SURVEY.md §7 "hard parts" #2):
+
+* *untracked* job types (``ps``/``tensorboard``/``notebook``…) never affect the
+  final status and are torn down when the job completes;
+* if a *chief-like* task (``chief``/``master``) exists, its completion ends the
+  job with its exit code ("stop on chief done");
+* otherwise the job succeeds when **all tracked** tasks exit 0, and (with
+  fail-fast on, the default) fails on the first tracked non-zero exit;
+* a task that misses too many heartbeats is marked LOST and fails the job.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tony_tpu import constants
+from tony_tpu.conf import TonyConfig
+
+
+class TaskStatus(enum.Enum):
+    """Lifecycle of one task (reference: ``TonySession.TaskStatus``)."""
+    NEW = "NEW"                  # declared in config, no container yet
+    REQUESTED = "REQUESTED"      # container requested from the scheduler
+    ALLOCATED = "ALLOCATED"      # container granted, executor launching
+    REGISTERED = "REGISTERED"    # executor called registerWorkerSpec
+    RUNNING = "RUNNING"          # gang barrier passed, user process running
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    LOST = "LOST"                # missed-heartbeat expiry
+    KILLED = "KILLED"            # torn down (untracked at job end, or preempted)
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED,
+                        TaskStatus.LOST, TaskStatus.KILLED)
+
+
+class JobStatus(enum.Enum):
+    """Final-status of the whole application (reference: ``FinalApplicationStatus``)."""
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+class TonyTask:
+    """One (job_type, index) task and its container/executor state."""
+
+    def __init__(self, job_type: str, index: int, tracked: bool):
+        self.job_type = job_type
+        self.index = index
+        self.tracked = tracked
+        self.status = TaskStatus.NEW
+        self.host: Optional[str] = None
+        self.port: Optional[int] = None          # rendezvous port registered by executor
+        self.container_id: Optional[str] = None
+        self.exit_code: Optional[int] = None
+        self.diagnostics: str = ""
+        self.last_heartbeat: float = 0.0
+        self.start_time: float = 0.0
+        self.end_time: float = 0.0
+        self.preemption_retries = 0
+        self.metrics: Dict[str, float] = {}
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_type}:{self.index}"
+
+    @property
+    def spec(self) -> Optional[str]:
+        if self.host is None or self.port is None:
+            return None
+        return f"{self.host}:{self.port}"
+
+    def touch(self) -> None:
+        self.last_heartbeat = time.monotonic()
+
+    def to_info(self) -> Dict[str, object]:
+        """Wire form served over ``getTaskInfos`` (reference: ``TaskInfo``)."""
+        return {
+            "job_type": self.job_type,
+            "index": self.index,
+            "status": self.status.value,
+            "host": self.host,
+            "port": self.port,
+            "tracked": self.tracked,
+            "exit_code": self.exit_code,
+            "diagnostics": self.diagnostics,
+            "metrics": dict(self.metrics),
+        }
+
+    def __repr__(self) -> str:
+        return f"TonyTask({self.task_id}, {self.status.value})"
+
+
+class TonySession:
+    """Thread-safe task registry + job-final-status logic.
+
+    Built once per AM attempt from the effective config (reference:
+    ``TonySession.Builder``); the AM drives transitions, the RPC service reads
+    and writes under :attr:`lock`.
+    """
+
+    def __init__(self, conf: TonyConfig, app_id: str, attempt_id: int = 1):
+        self.conf = conf
+        self.app_id = app_id
+        self.attempt_id = attempt_id
+        self.lock = threading.RLock()
+        self.job_status = JobStatus.RUNNING
+        self.final_message = ""
+        self.tensorboard_url: Optional[str] = None
+        self._tasks: Dict[Tuple[str, int], TonyTask] = {}
+        untracked = set(conf.untracked_job_types())
+        for jt in conf.job_types():
+            for i in range(conf.instances(jt)):
+                self._tasks[(jt, i)] = TonyTask(jt, i, tracked=jt not in untracked)
+
+    # -- registry ----------------------------------------------------------
+    def task(self, job_type: str, index: int) -> TonyTask:
+        with self.lock:
+            key = (job_type, int(index))
+            if key not in self._tasks:
+                raise KeyError(f"unknown task {job_type}:{index}")
+            return self._tasks[key]
+
+    def tasks(self) -> List[TonyTask]:
+        with self.lock:
+            return list(self._tasks.values())
+
+    def tracked_tasks(self) -> List[TonyTask]:
+        return [t for t in self.tasks() if t.tracked]
+
+    def untracked_tasks(self) -> List[TonyTask]:
+        return [t for t in self.tasks() if not t.tracked]
+
+    def task_by_container(self, container_id: str) -> Optional[TonyTask]:
+        with self.lock:
+            for t in self._tasks.values():
+                if t.container_id == container_id:
+                    return t
+        return None
+
+    def __iter__(self) -> Iterator[TonyTask]:
+        return iter(self.tasks())
+
+    # -- cluster spec (gang barrier) ---------------------------------------
+    def all_registered(self) -> bool:
+        """True once every task has called registerWorkerSpec — the gang
+        barrier after which executors may start user processes."""
+        with self.lock:
+            return all(t.spec is not None for t in self._tasks.values())
+
+    def cluster_spec(self) -> Dict[str, List[str]]:
+        """``{job_type: ["host:port", ...]}`` ordered by task index
+        (reference: ``TonySession#getClusterSpec``)."""
+        with self.lock:
+            spec: Dict[str, List[str]] = {}
+            for jt in self.conf.job_types():
+                members = []
+                for i in range(self.conf.instances(jt)):
+                    t = self._tasks[(jt, i)]
+                    members.append(t.spec or "")
+                spec[jt] = members
+            return spec
+
+    # -- global rank assignment (TPU-native addition) ----------------------
+    def global_rank(self, job_type: str, index: int) -> int:
+        """Deterministic dense rank over all tasks, ordered (job_types(),
+        index). Used by JAXRuntime for ``process_id`` and by the PyTorch/
+        Horovod adapters for RANK/HOROVOD_RANK."""
+        rank = 0
+        for jt in self.conf.job_types():
+            n = self.conf.instances(jt)
+            if jt == job_type:
+                if not (0 <= index < n):
+                    raise KeyError(f"unknown task {job_type}:{index}")
+                return rank + index
+            rank += n
+        raise KeyError(f"unknown job type {job_type}")
+
+    def num_tasks(self) -> int:
+        with self.lock:
+            return len(self._tasks)
+
+    # -- transitions driven by RPC/AM --------------------------------------
+    def on_registered(self, job_type: str, index: int, host: str, port: int) -> TonyTask:
+        with self.lock:
+            t = self.task(job_type, index)
+            t.host, t.port = host, int(port)
+            if not t.status.is_terminal:
+                t.status = TaskStatus.REGISTERED
+            t.touch()
+            return t
+
+    def on_running(self) -> None:
+        """Gang barrier passed: mark all registered tasks RUNNING."""
+        with self.lock:
+            now = time.monotonic()
+            for t in self._tasks.values():
+                if t.status == TaskStatus.REGISTERED:
+                    t.status = TaskStatus.RUNNING
+                    t.start_time = t.start_time or now
+
+    def on_heartbeat(self, job_type: str, index: int) -> None:
+        self.task(job_type, index).touch()
+
+    def on_task_result(self, job_type: str, index: int, exit_code: int,
+                       diagnostics: str = "") -> TonyTask:
+        with self.lock:
+            t = self.task(job_type, index)
+            if t.status.is_terminal:
+                return t
+            t.exit_code = int(exit_code)
+            t.diagnostics = diagnostics
+            t.end_time = time.monotonic()
+            t.status = TaskStatus.SUCCEEDED if exit_code == 0 else TaskStatus.FAILED
+            self._update_job_status()
+            return t
+
+    def on_task_lost(self, task: TonyTask, diagnostics: str) -> None:
+        with self.lock:
+            if task.status.is_terminal:
+                return
+            task.status = TaskStatus.LOST
+            task.exit_code = constants.EXIT_LOST_TASK
+            task.diagnostics = diagnostics
+            task.end_time = time.monotonic()
+            self._update_job_status()
+
+    def kill_remaining(self, reason: str) -> List[TonyTask]:
+        """Mark all non-terminal tasks KILLED (untracked teardown at job end,
+        or client-initiated kill). Returns the tasks transitioned."""
+        with self.lock:
+            killed = []
+            for t in self._tasks.values():
+                if not t.status.is_terminal:
+                    t.status = TaskStatus.KILLED
+                    t.exit_code = constants.EXIT_KILLED
+                    t.diagnostics = reason
+                    t.end_time = time.monotonic()
+                    killed.append(t)
+            return killed
+
+    # -- success policy ----------------------------------------------------
+    def _chief_task(self) -> Optional[TonyTask]:
+        for jt in constants.CHIEF_LIKE_JOB_TYPES:
+            with self.lock:
+                for (t_jt, _i), t in sorted(self._tasks.items()):
+                    if t_jt == jt:
+                        return t
+        return None
+
+    def _update_job_status(self) -> None:
+        """Re-derive the job status after any tracked-task transition.
+        Must be called with the lock held."""
+        if self.job_status != JobStatus.RUNNING:
+            return
+        fail_fast = self.conf.get_bool(
+            "tony.application.fail-fast", True)
+        chief = self._chief_task()
+        if chief is not None and chief.tracked and chief.status.is_terminal:
+            # Chief-done policy: the chief's exit decides the job.
+            if chief.status == TaskStatus.SUCCEEDED:
+                self.job_status = JobStatus.SUCCEEDED
+                self.final_message = "chief completed successfully"
+            else:
+                self.job_status = JobStatus.FAILED
+                self.final_message = (
+                    f"chief {chief.task_id} {chief.status.value}: {chief.diagnostics}")
+            return
+        tracked = [t for t in self._tasks.values() if t.tracked]
+        failed = [t for t in tracked
+                  if t.status in (TaskStatus.FAILED, TaskStatus.LOST)]
+        if failed and fail_fast:
+            t = failed[0]
+            self.job_status = JobStatus.FAILED
+            self.final_message = (
+                f"task {t.task_id} {t.status.value} "
+                f"(exit={t.exit_code}): {t.diagnostics}")
+            return
+        if tracked and all(t.status.is_terminal for t in tracked):
+            if failed:
+                t = failed[0]
+                self.job_status = JobStatus.FAILED
+                self.final_message = (
+                    f"{len(failed)}/{len(tracked)} tracked tasks failed; first: "
+                    f"{t.task_id} exit={t.exit_code}")
+            else:
+                self.job_status = JobStatus.SUCCEEDED
+                self.final_message = "all tracked tasks completed successfully"
+
+    def is_done(self) -> bool:
+        with self.lock:
+            return self.job_status != JobStatus.RUNNING
+
+    def task_infos(self) -> List[Dict[str, object]]:
+        return [t.to_info() for t in self.tasks()]
