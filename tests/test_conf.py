@@ -92,3 +92,13 @@ def test_json_roundtrip():
     clone = TonyConfig.from_json(cfg.to_json())
     assert clone.instances("worker") == 3
     assert dict(clone.items()) == dict(cfg.items())
+
+
+def test_job_types_chief_like_order_canonical():
+    # 'master' inserted before 'chief' in the props: canonical order must
+    # still be (chief, master, ...) regardless of dict insertion order.
+    cfg = TonyConfig({"tony.master.instances": "1", "tony.chief.instances": "1",
+                      "tony.worker.instances": "2"})
+    assert cfg.job_types() == ["chief", "master", "worker"]
+    # Round-trip through JSON (sorted keys) must agree.
+    assert TonyConfig.from_json(cfg.to_json()).job_types() == cfg.job_types()

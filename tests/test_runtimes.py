@@ -145,3 +145,81 @@ def test_tb_port_reservation_policy():
     assert not ad.need_reserve_tb_port(
         ctx_for("jax", "chief", 0, spec=spec,
                 conf_extra={"tony.tensorboard.instances": "1"}))
+
+
+# --- sidecar-exclusion semantics (round-2 fixes) ---------------------------
+
+SIDECAR_SPEC = {
+    "chief": ["h0:4000"],
+    "worker": ["h0:4001", "h1:4002"],
+    "tensorboard": ["h1:5000"],
+}
+SIDECAR_CONF = {"tony.chief.instances": "1", "tony.worker.instances": "2",
+                "tony.tensorboard.instances": "1"}
+
+
+def sidecar_ctx(framework, job_type, index):
+    return ctx_for(framework, job_type, index, spec=SIDECAR_SPEC,
+                   conf_extra=SIDECAR_CONF)
+
+
+def test_jax_world_excludes_sidecars():
+    env = get_framework("jax").task_adapter().build_task_env(
+        sidecar_ctx("jax", "worker", 1))
+    # 3 rendezvous tasks, not 4: the tensorboard sidecar is not in the world.
+    assert env[constants.ENV_NUM_PROCESSES] == "3"
+    assert env[constants.ENV_PROCESS_ID] == "2"
+    assert env[constants.ENV_COORDINATOR_ADDRESS] == "h0:4000"
+    assert env[constants.ENV_TPU_WORKER_HOSTNAMES] == "h0,h0,h1"
+
+
+def test_sidecar_task_gets_no_rendezvous_env():
+    for fw in ("jax", "pytorch", "horovod", "mxnet"):
+        env = get_framework(fw).task_adapter().build_task_env(
+            sidecar_ctx(fw, "tensorboard", 0))
+        for key in (constants.ENV_COORDINATOR_ADDRESS, constants.ENV_RANK,
+                    constants.ENV_HOROVOD_RANK, constants.ENV_DMLC_ROLE):
+            assert key not in env, (fw, key)
+        # Common env still present so the sidecar knows who it is.
+        assert env[constants.ENV_JOB_TYPE] == "tensorboard"
+
+
+def test_pytorch_world_excludes_sidecars():
+    env = get_framework("pytorch").task_adapter().build_task_env(
+        sidecar_ctx("pytorch", "worker", 1))
+    assert env[constants.ENV_WORLD_SIZE] == "3"
+    assert env[constants.ENV_RANK] == "2"
+    # LOCAL_RANK counts only rendezvous tasks on h1 (tb excluded).
+    assert env[constants.ENV_LOCAL_RANK] == "0"
+
+
+def test_jax_chip_pinning_mixed_tpus():
+    # chief (tpus=4) and worker:0 (tpus=2) share h0; worker:0's chips start
+    # after the chief's four, not at local_rank*2.
+    conf_extra = {"tony.chief.tpus": "4", "tony.worker.tpus": "2"}
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0, conf_extra=conf_extra))
+    assert env[constants.ENV_TPU_VISIBLE_DEVICES] == "4,5"
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "chief", 0, conf_extra=conf_extra))
+    assert env[constants.ENV_TPU_VISIBLE_DEVICES] == "0,1,2,3"
+
+
+def test_global_rank_out_of_range_raises():
+    ctx = ctx_for("jax", "worker", 9)
+    with pytest.raises(KeyError):
+        ctx.global_rank()
+
+
+def test_horovod_validate_idempotent():
+    fw = get_framework("horovod")
+    am = fw.am_adapter()
+    conf = TonyConfig({"tony.worker.instances": "2",
+                       "tony.application.framework": "horovod"})
+    try:
+        am.validate_and_update_config(conf)
+        first = am.driver
+        am.validate_and_update_config(conf)
+        assert am.driver is first
+    finally:
+        am.stop()

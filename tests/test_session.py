@@ -120,3 +120,34 @@ def test_terminal_result_is_idempotent():
     s.on_task_result("worker", 0, 0)   # late duplicate must not flip status
     assert s.task("worker", 0).exit_code == 1
     assert s.job_status == JobStatus.FAILED
+
+
+# --- round-2 policy fixes ---------------------------------------------------
+
+def test_multi_chief_requires_all_chiefs():
+    s = make_session(**{"tony.chief.instances": "2", "tony.worker.instances": "1"})
+    s.on_task_result("chief", 0, 0)
+    assert s.job_status is JobStatus.RUNNING      # one of two chiefs done
+    s.on_task_result("chief", 1, 0)
+    assert s.job_status is JobStatus.SUCCEEDED
+
+
+def test_multi_chief_any_failure_fails():
+    s = make_session(**{"tony.chief.instances": "2", "tony.worker.instances": "1"})
+    s.on_task_result("chief", 1, 3)
+    assert s.job_status is JobStatus.FAILED
+
+
+def test_worker_failfast_applies_while_chief_runs():
+    s = make_session(**{"tony.chief.instances": "1", "tony.worker.instances": "2"})
+    s.on_task_result("worker", 0, 1)
+    assert s.job_status is JobStatus.FAILED
+
+
+def test_global_rank_skips_sidecars():
+    s = make_session(**{"tony.chief.instances": "1", "tony.worker.instances": "2",
+                        "tony.tensorboard.instances": "1"})
+    assert s.global_rank("chief", 0) == 0
+    assert s.global_rank("worker", 1) == 2
+    with pytest.raises(KeyError):
+        s.global_rank("tensorboard", 0)

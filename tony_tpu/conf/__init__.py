@@ -212,7 +212,10 @@ class TonyConfig:
                 continue
             if self.get_int(key, 0) > 0:
                 found.append(jt)
-        chief_like = [t for t in found if t in constants.CHIEF_LIKE_JOB_TYPES]
+        # Canonical chief-like order (CHIEF_LIKE_JOB_TYPES order, NOT dict
+        # insertion order) so the AM and every executor — which load the
+        # config from different serializations — agree on rank 0.
+        chief_like = [t for t in constants.CHIEF_LIKE_JOB_TYPES if t in found]
         rest = sorted(t for t in found if t not in constants.CHIEF_LIKE_JOB_TYPES)
         return chief_like + rest
 
@@ -252,9 +255,6 @@ class TonyConfig:
             raise ValueError(
                 "no job types configured: set at least one tony.<jobtype>.instances > 0")
         for jt in self.job_types():
-            n = self.instances(jt)
-            if n < 0:
-                raise ValueError(f"{instances_key(jt)} must be >= 0, got {n}")
             if self.get_int(vcores_key(jt), 1) <= 0:
                 raise ValueError(f"{vcores_key(jt)} must be > 0")
         framework = self.get(APPLICATION_FRAMEWORK, "jax")

@@ -80,6 +80,12 @@ SCHEDULER = "scheduler"         # MXNet kvstore scheduler
 # Job types whose completion drives the "chief done => job done" policy.
 CHIEF_LIKE_JOB_TYPES = (CHIEF, MASTER)
 
+# Sidecar job types: never part of the ML rendezvous world (excluded from
+# RANK/WORLD_SIZE/coordinator selection the way the reference's TFRuntime
+# excludes them from TF_CONFIG). Distinct from *untracked* types: ``ps`` is
+# untracked by default but IS a cluster member.
+SIDECAR_JOB_TYPES = (TENSORBOARD, NOTEBOOK, DRIVER)
+
 # --- File-layout conventions ------------------------------------------------
 TONY_XML = "tony.xml"                       # user config file name (compat)
 TONY_JOB_JSON = "tony-job.json"             # serialized effective config

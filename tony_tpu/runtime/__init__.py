@@ -46,19 +46,41 @@ class TaskContext:
     def job_types(self) -> List[str]:
         return self.conf.job_types()
 
+    def ml_job_types(self) -> List[str]:
+        """Job types that are part of the rendezvous world: everything except
+        sidecars (tensorboard/notebook/driver). Rank assignment, world size
+        and coordinator selection all run over these only — a configured
+        sidecar must never become the coordinator or inflate WORLD_SIZE."""
+        from tony_tpu import constants
+        return [jt for jt in self.job_types()
+                if jt not in constants.SIDECAR_JOB_TYPES]
+
+    def is_sidecar(self) -> bool:
+        from tony_tpu import constants
+        return self.job_type in constants.SIDECAR_JOB_TYPES
+
     def num_tasks(self) -> int:
+        """All tasks in the job, sidecars included (``TONY_NUM_TASKS``)."""
         return sum(len(v) for v in self.cluster_spec.values())
 
+    def num_cluster_tasks(self) -> int:
+        """World size for rendezvous purposes: sidecars excluded."""
+        return sum(len(self.cluster_spec.get(jt, []))
+                   for jt in self.ml_job_types())
+
     def global_rank(self) -> int:
-        """Dense rank over (job_types order, index) — must match
-        ``TonySession.global_rank``."""
+        """Dense rank over (ml_job_types order, index) — must match
+        ``TonySession.global_rank``. Raises for sidecar tasks and for
+        out-of-range indices (mirroring ``TonySession.global_rank``)."""
         rank = 0
-        for jt in self.job_types():
+        for jt in self.ml_job_types():
             n = len(self.cluster_spec.get(jt, []))
             if jt == self.job_type:
+                if not (0 <= self.index < n):
+                    raise KeyError(f"unknown task {self.job_type}:{self.index}")
                 return rank + self.index
             rank += n
-        raise KeyError(f"job type {self.job_type} not in cluster spec")
+        raise KeyError(f"job type {self.job_type} not in the rendezvous world")
 
     def spec_of(self, job_type: str, index: int) -> str:
         members = self.cluster_spec.get(job_type, [])
@@ -67,9 +89,9 @@ class TaskContext:
         return members[index]
 
     def rank0_spec(self) -> str:
-        """host:port of the global-rank-0 task (the coordinator)."""
-        first_jt = self.job_types()[0]
-        return self.spec_of(first_jt, 0)
+        """host:port of the global-rank-0 task (the coordinator) — the first
+        non-sidecar job type's task 0."""
+        return self.spec_of(self.ml_job_types()[0], 0)
 
     def host_of(self, job_type: str, index: int) -> str:
         return self.spec_of(job_type, index).rsplit(":", 1)[0]
@@ -77,19 +99,25 @@ class TaskContext:
     def my_host(self) -> str:
         return self.host_of(self.job_type, self.index)
 
-    def local_rank(self) -> tuple[int, int]:
-        """(local_rank, local_size) among tasks sharing this task's host,
-        ordered by global rank — Horovod/PyTorch local-rank semantics."""
-        me = self.global_rank()
+    def host_cohort(self) -> List[tuple[int, str]]:
+        """(global_rank, job_type) of every rendezvous task sharing this
+        task's host, ordered by global rank — the basis for local-rank and
+        chip-pinning math."""
         host = self.my_host()
         cohort = []
         rank = 0
-        for jt in self.job_types():
-            for i, spec in enumerate(self.cluster_spec.get(jt, [])):
+        for jt in self.ml_job_types():
+            for spec in self.cluster_spec.get(jt, []):
                 if spec and spec.rsplit(":", 1)[0] == host:
-                    cohort.append(rank)
+                    cohort.append((rank, jt))
                 rank += 1
-        cohort.sort()
+        return cohort
+
+    def local_rank(self) -> tuple[int, int]:
+        """(local_rank, local_size) among rendezvous tasks sharing this task's
+        host, ordered by global rank — Horovod/PyTorch local-rank semantics."""
+        me = self.global_rank()
+        cohort = [r for r, _jt in self.host_cohort()]
         return cohort.index(me), len(cohort)
 
 

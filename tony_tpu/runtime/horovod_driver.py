@@ -73,7 +73,10 @@ class HorovodDriver:
             return list(self._slots) if self._slots is not None else None
 
     def _serve(self) -> None:
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:          # stop() closed the socket before we started
+            return
         while not self._stopped.is_set():
             try:
                 conn, _ = self._sock.accept()
@@ -86,6 +89,8 @@ class HorovodDriver:
                     payload = {"ready": self._slots is not None,
                                "slots": self._slots or []}
                 conn.sendall(json.dumps(payload).encode())
+            except OSError:
+                pass
             finally:
                 conn.close()
 

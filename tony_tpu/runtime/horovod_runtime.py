@@ -33,12 +33,17 @@ class HorovodAMAdapter(ApplicationMasterAdapter):
         self.driver: Optional[HorovodDriver] = None
 
     def validate_and_update_config(self, conf) -> None:
-        self.driver = HorovodDriver()
+        # Idempotent: validation may run more than once per AM attempt and a
+        # repeated call must not leak the previous listener socket/thread.
+        if self.driver is None:
+            self.driver = HorovodDriver()
 
     def on_all_registered(self) -> None:
         hosts = []
         spec = self.session.cluster_spec()
         for jt in self.session.conf.job_types():
+            if jt in constants.SIDECAR_JOB_TYPES:
+                continue
             for member in spec.get(jt, []):
                 hosts.append(member.rsplit(":", 1)[0])
         assert self.driver is not None
@@ -55,12 +60,15 @@ class HorovodAMAdapter(ApplicationMasterAdapter):
 
 class HorovodTaskAdapter(MLGenericTaskAdapter):
     def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        if ctx.is_sidecar():
+            # Sidecars hold no Horovod slot and must not inflate HOROVOD_SIZE.
+            return {}
         rank = ctx.global_rank()
-        n = ctx.num_tasks()
+        n = ctx.num_cluster_tasks()
         local_rank, local_size = ctx.local_rank()
         # cross rank: index of this host among distinct hosts, host-major.
         distinct = []
-        for jt in ctx.job_types():
+        for jt in ctx.ml_job_types():
             for spec in ctx.cluster_spec.get(jt, []):
                 h = spec.rsplit(":", 1)[0]
                 if h not in distinct:

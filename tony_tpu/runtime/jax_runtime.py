@@ -30,9 +30,15 @@ from tony_tpu.runtime.base import MLGenericTaskAdapter
 
 class JAXTaskAdapter(MLGenericTaskAdapter):
     def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        if ctx.is_sidecar():
+            # Sidecars (tensorboard/notebook/driver) are not part of the SPMD
+            # world: no coordinator triple, no chip pinning — exporting them
+            # would make jax.distributed.initialize wait on a process that
+            # never joins.
+            return {}
         coordinator = ctx.rank0_spec()
         rank = ctx.global_rank()
-        n = ctx.num_tasks()
+        n = ctx.num_cluster_tasks()
         env = {
             constants.ENV_COORDINATOR_ADDRESS: coordinator,
             constants.ENV_PROCESS_ID: str(rank),
@@ -40,15 +46,18 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
         }
         tpus = ctx.conf.get_int(f"tony.{ctx.job_type}.tpus", 0)
         if tpus > 0:
-            # Chip pinning: tasks sharing a host each see a disjoint chip set.
-            local_rank, _ = ctx.local_rank()
-            first = local_rank * tpus
+            # Chip pinning: tasks sharing a host each see a disjoint chip
+            # set. The offset is the cumulative chip count of lower-ranked
+            # co-hosted tasks (each sized by its OWN job type's tpus), so
+            # mixed-tpus cohorts neither overlap nor leave gaps.
+            first = sum(ctx.conf.get_int(f"tony.{jt}.tpus", 0)
+                        for r, jt in ctx.host_cohort() if r < rank)
             chips = ",".join(str(first + i) for i in range(tpus))
             env[constants.ENV_TPU_VISIBLE_DEVICES] = chips
             env[constants.ENV_LOCAL_DEVICE_IDS] = chips
         # libtpu multi-host topology (harmless off-pod; required on pods).
         hosts = []
-        for jt in ctx.job_types():
+        for jt in ctx.ml_job_types():
             for spec in ctx.cluster_spec.get(jt, []):
                 hosts.append(spec.rsplit(":", 1)[0] if spec else "")
         env[constants.ENV_TPU_WORKER_ID] = str(rank)

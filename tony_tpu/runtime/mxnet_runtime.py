@@ -19,6 +19,10 @@ _ROLE_MAP = {constants.SCHEDULER: "scheduler", "server": "server",
 
 class MXNetTaskAdapter(MLGenericTaskAdapter):
     def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        if ctx.is_sidecar():
+            # Sidecars take no DMLC role (a tensorboard task must not come up
+            # as a phantom worker in the kvstore ring).
+            return {}
         sched = ctx.spec_of(constants.SCHEDULER, 0)
         host, _, port = sched.rpartition(":")
         n_server = sum(len(ctx.cluster_spec.get(jt, []))

@@ -18,6 +18,10 @@ from tony_tpu.runtime.base import MLGenericTaskAdapter
 
 class PyTorchTaskAdapter(MLGenericTaskAdapter):
     def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
+        if ctx.is_sidecar():
+            # Sidecars never join the process group: no RANK/WORLD_SIZE, or
+            # init_process_group would wait on a process that never arrives.
+            return {}
         master = ctx.rank0_spec()
         host, _, port = master.rpartition(":")
         local_rank, _local_size = ctx.local_rank()
@@ -25,7 +29,7 @@ class PyTorchTaskAdapter(MLGenericTaskAdapter):
             constants.ENV_MASTER_ADDR: host,
             constants.ENV_MASTER_PORT: port,
             constants.ENV_RANK: str(ctx.global_rank()),
-            constants.ENV_WORLD_SIZE: str(ctx.num_tasks()),
+            constants.ENV_WORLD_SIZE: str(ctx.num_cluster_tasks()),
             constants.ENV_LOCAL_RANK: str(local_rank),
             constants.ENV_INIT_METHOD: f"tcp://{master}",
         }

@@ -21,7 +21,7 @@ from tony_tpu.runtime import Framework, TaskContext
 from tony_tpu.runtime.base import MLGenericTaskAdapter
 
 # Sidecar types never included in the TF cluster spec.
-_NON_CLUSTER_TYPES = {constants.TENSORBOARD, constants.NOTEBOOK, constants.DRIVER}
+_NON_CLUSTER_TYPES = set(constants.SIDECAR_JOB_TYPES)
 
 
 class TFTaskAdapter(MLGenericTaskAdapter):

@@ -173,11 +173,14 @@ class TonySession:
 
     # -- global rank assignment (TPU-native addition) ----------------------
     def global_rank(self, job_type: str, index: int) -> int:
-        """Deterministic dense rank over all tasks, ordered (job_types(),
-        index). Used by JAXRuntime for ``process_id`` and by the PyTorch/
-        Horovod adapters for RANK/HOROVOD_RANK."""
+        """Deterministic dense rank over rendezvous tasks (sidecars excluded),
+        ordered (job_types(), index). Used by JAXRuntime for ``process_id``
+        and by the PyTorch/Horovod adapters for RANK/HOROVOD_RANK. Must match
+        ``TaskContext.global_rank``."""
         rank = 0
         for jt in self.conf.job_types():
+            if jt in constants.SIDECAR_JOB_TYPES:
+                continue
             n = self.conf.instances(jt)
             if jt == job_type:
                 if not (0 <= index < n):
@@ -250,13 +253,16 @@ class TonySession:
             return killed
 
     # -- success policy ----------------------------------------------------
-    def _chief_task(self) -> Optional[TonyTask]:
+    def _chief_tasks(self) -> List[TonyTask]:
+        """All tracked chief-like tasks, in (CHIEF_LIKE_JOB_TYPES, index)
+        order. Plural on purpose: ``chief.instances=2`` or chief+master
+        configs make every one of them decide the job, not just the first."""
+        out = []
         for jt in constants.CHIEF_LIKE_JOB_TYPES:
-            with self.lock:
-                for (t_jt, _i), t in sorted(self._tasks.items()):
-                    if t_jt == jt:
-                        return t
-        return None
+            for (t_jt, _i), t in sorted(self._tasks.items()):
+                if t_jt == jt and t.tracked:
+                    out.append(t)
+        return out
 
     def _update_job_status(self) -> None:
         """Re-derive the job status after any tracked-task transition.
@@ -265,17 +271,25 @@ class TonySession:
             return
         fail_fast = self.conf.get_bool(
             "tony.application.fail-fast", True)
-        chief = self._chief_task()
-        if chief is not None and chief.tracked and chief.status.is_terminal:
-            # Chief-done policy: the chief's exit decides the job.
-            if chief.status == TaskStatus.SUCCEEDED:
-                self.job_status = JobStatus.SUCCEEDED
-                self.final_message = "chief completed successfully"
-            else:
+        chiefs = self._chief_tasks()
+        if chiefs:
+            # Chief-done policy: the chiefs' exits decide the job. A failed
+            # chief fails the job immediately; success requires all chiefs.
+            # If no chief has decided yet, fall through so fail-fast on other
+            # tracked tasks still applies while the chief runs.
+            failed_chief = next(
+                (c for c in chiefs if c.status.is_terminal
+                 and c.status != TaskStatus.SUCCEEDED), None)
+            if failed_chief is not None:
                 self.job_status = JobStatus.FAILED
                 self.final_message = (
-                    f"chief {chief.task_id} {chief.status.value}: {chief.diagnostics}")
-            return
+                    f"chief {failed_chief.task_id} {failed_chief.status.value}: "
+                    f"{failed_chief.diagnostics}")
+                return
+            if all(c.status == TaskStatus.SUCCEEDED for c in chiefs):
+                self.job_status = JobStatus.SUCCEEDED
+                self.final_message = "chief completed successfully"
+                return
         tracked = [t for t in self._tasks.values() if t.tracked]
         failed = [t for t in tracked
                   if t.status in (TaskStatus.FAILED, TaskStatus.LOST)]
