@@ -1,0 +1,232 @@
+"""End-to-end tests on the MiniPod: real AM thread, real executor processes,
+stub python workloads (reference tier: ``TestTonyE2E`` on MiniYARNCluster —
+SURVEY.md §4). Every failure semantic is exercised live, not mocked."""
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.minipod import MiniPod
+from tony_tpu.session import JobStatus, TaskStatus
+
+WORKLOADS = Path(__file__).parent / "workloads"
+
+
+def wl(name: str) -> str:
+    return f"python {name}"
+
+
+@pytest.fixture
+def pod(tmp_path):
+    return MiniPod(tmp_path)
+
+
+def props(**over):
+    base = {
+        "tony.application.framework": "standalone",
+        "tony.application.executes": wl("exit_0.py"),
+    }
+    base.update({k: str(v) for k, v in over.items()})
+    return base
+
+
+def test_single_task_success(pod):
+    job = pod.run(props(**{"tony.worker.instances": "1"}),
+                  src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    assert job.session.job_status is JobStatus.SUCCEEDED
+    t = job.session.task("worker", 0)
+    assert t.status is TaskStatus.SUCCEEDED and t.exit_code == 0
+
+
+def test_two_worker_gang_success(pod):
+    job = pod.run(props(**{"tony.worker.instances": "2"}),
+                  src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    assert all(t.status is TaskStatus.SUCCEEDED for t in job.session.tasks())
+
+
+def test_tracked_failure_fails_fast(pod):
+    job = pod.run(props(**{
+        "tony.worker.instances": "1",
+        "tony.sleeper.instances": "1",
+        "tony.worker.command": wl("exit_1.py"),
+        "tony.sleeper.command": wl("forever.py"),
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 1
+    assert job.session.job_status is JobStatus.FAILED
+    assert job.session.task("worker", 0).status is TaskStatus.FAILED
+    # The forever-sleeper was torn down, not left running.
+    assert job.session.task("sleeper", 0).status is TaskStatus.KILLED
+    assert not job.scheduler.running()
+
+
+def test_untracked_crash_ignored(pod):
+    # ps is untracked by default: its crash must not fail the job. The
+    # worker sleeps so the ps failure deterministically lands while the job
+    # is still running (not during teardown).
+    job = pod.run(props(**{
+        "tony.application.framework": "tensorflow",
+        "tony.worker.instances": "1",
+        "tony.worker.command": wl("sleep_exit_0.py"),
+        "tony.ps.instances": "1",
+        "tony.ps.command": wl("exit_1.py"),
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    assert job.session.job_status is JobStatus.SUCCEEDED
+    assert job.session.task("ps", 0).status is TaskStatus.FAILED
+
+
+def test_chief_done_tears_down_workers(pod):
+    job = pod.run(props(**{
+        "tony.chief.instances": "1",
+        "tony.worker.instances": "1",
+        "tony.chief.command": wl("exit_0.py"),
+        "tony.worker.command": wl("forever.py"),
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    assert job.session.job_status is JobStatus.SUCCEEDED
+    assert job.session.task("worker", 0).status is TaskStatus.KILLED
+    assert not job.scheduler.running()
+
+
+def test_heartbeat_timeout_marks_lost(pod):
+    job = pod.submit(props(**{
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("forever.py"),
+        "tony.task.max-missed-heartbeats": "4",   # 4 * 200ms = 800ms expiry
+    }), src_dir=WORKLOADS)
+    # Wait until the task is live, then freeze the whole executor process
+    # group: alive but silent -> missed heartbeats -> LOST.
+    job.wait_for(lambda: job.session is not None
+                 and job.session.task("worker", 0).status is TaskStatus.RUNNING,
+                 what="worker running")
+    [container] = job.scheduler.running()
+    os.killpg(container._proc.pid, signal.SIGSTOP)
+    try:
+        assert job.wait(timeout=30) == 1
+    finally:
+        try:
+            os.killpg(container._proc.pid, signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+    t = job.session.task("worker", 0)
+    assert t.status is TaskStatus.LOST
+    assert t.exit_code == constants.EXIT_LOST_TASK
+    assert "heartbeat" in job.session.final_message
+
+
+def test_env_contract_reaches_user_process(pod, tmp_path):
+    job = pod.run(props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "2",
+        "tony.application.executes": wl("check_env.py"),
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    env_files = list(Path(job.am.job_dir).glob("containers/*/src/env.json"))
+    assert len(env_files) == 2
+    envs = [json.loads(p.read_text()) for p in env_files]
+    ranks = sorted(int(e[constants.ENV_PROCESS_ID]) for e in envs)
+    assert ranks == [0, 1]
+    for e in envs:
+        assert e[constants.ENV_NUM_PROCESSES] == "2"
+        spec = json.loads(e[constants.ENV_DIST_SPEC])
+        assert len(spec["worker"]) == 2
+        # Coordinator is worker:0's registered spec for every process.
+        assert e[constants.ENV_COORDINATOR_ADDRESS] == spec["worker"][0]
+
+
+def test_preemption_relaunches_task(pod):
+    job = pod.submit(props(**{
+        "tony.worker.instances": "2",
+        "tony.application.executes": wl("forever.py"),
+    }), src_dir=WORKLOADS)
+    job.wait_for(lambda: job.session is not None and all(
+        t.status is TaskStatus.RUNNING for t in job.session.tasks()),
+        what="all running")
+    victim = job.session.task("worker", 0)
+    assert job.scheduler.preempt(victim.container_id)
+    # Task must come back: re-registered and RUNNING again, retry counted.
+    job.wait_for(lambda: victim.preemption_retries == 1
+                 and victim.status is TaskStatus.RUNNING,
+                 what="preempted task relaunched")
+    assert job.session.job_status is JobStatus.RUNNING
+    job.kill()
+    assert job.wait(timeout=30) == 1
+    assert job.session.job_status is JobStatus.KILLED
+
+
+def test_preemption_retries_exhausted_fails(pod):
+    job = pod.submit(props(**{
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("forever.py"),
+        "tony.container.preemption.max-retries": "0",
+    }), src_dir=WORKLOADS)
+    job.wait_for(lambda: job.session is not None
+                 and job.session.task("worker", 0).status is TaskStatus.RUNNING,
+                 what="worker running")
+    assert job.scheduler.preempt(job.session.task("worker", 0).container_id)
+    assert job.wait(timeout=30) == 1
+    t = job.session.task("worker", 0)
+    assert t.status is TaskStatus.FAILED
+    assert t.exit_code == constants.EXIT_PREEMPTED
+
+
+def test_am_gang_restart_retries_whole_attempt(pod):
+    job = pod.run(props(**{
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("flaky_once.py"),
+        "tony.am.retry-count": "1",
+    }), src_dir=WORKLOADS)
+    # Attempt 1 fails (marker created), attempt 2 succeeds.
+    assert job.exit_code == 0
+    assert job.session.attempt_id == 2
+    assert job.session.job_status is JobStatus.SUCCEEDED
+
+
+def test_execution_timeout_kills_user_process(pod):
+    job = pod.run(props(**{
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("forever.py"),
+        "tony.task.executor.execution-timeout-ms": "500",
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 1
+    t = job.session.task("worker", 0)
+    assert t.status is TaskStatus.FAILED
+    assert "timed out" in t.diagnostics
+
+
+def test_security_token_plumbed_end_to_end(pod):
+    job = pod.run(props(**{
+        "tony.worker.instances": "1",
+        "tony.security.enabled": "true",
+        "tony.application.executes": wl("check_env.py"),
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    [env_file] = Path(job.am.job_dir).glob("containers/*/src/env.json")
+    env = json.loads(env_file.read_text())
+    token = (Path(job.am.job_dir) / "am.token").read_text()
+    assert env["TONY_JOB_TOKEN"] == token
+
+
+def test_events_written_and_finalized(pod):
+    from tony_tpu import events as ev
+    job = pod.run(props(**{"tony.worker.instances": "1"}), src_dir=WORKLOADS)
+    history = Path(job.am.job_dir) / "history"
+    finished = list((history / "finished").glob("*.jhist"))
+    assert len(finished) == 1
+    records = ev.read_events(finished[0])
+    types = [r["type"] for r in records]
+    assert types[0] == "METADATA"
+    assert "APPLICATION_INITED" in types
+    assert "TASK_STARTED" in types
+    assert "TASK_FINISHED" in types
+    assert types[-1] == "APPLICATION_FINISHED"
+    assert records[-1]["payload"]["status"] == "SUCCEEDED"
+    meta = ev.job_metadata(finished[0])
+    assert meta["app_id"] == job.am.app_id

@@ -1,0 +1,119 @@
+"""Control-plane RPC tests (reference tier: rpc/ unit tests, SURVEY.md §4):
+server+client roundtrip, gang barrier over the wire, token auth, error
+transport, reconnection."""
+
+import threading
+
+import pytest
+
+from tony_tpu.conf import TonyConfig
+from tony_tpu.rpc import ApplicationRpcHandler, RpcClient, RpcError, RpcServer
+from tony_tpu.session import JobStatus, TonySession
+
+
+@pytest.fixture
+def server_and_session():
+    conf = TonyConfig({"tony.worker.instances": "2"})
+    session = TonySession(conf, app_id="app_rpc_0001")
+    handler = ApplicationRpcHandler(session)
+    server = RpcServer(handler, host="127.0.0.1").start()
+    yield server, handler, session
+    server.stop()
+
+
+def test_register_and_gang_barrier(server_and_session):
+    server, handler, session = server_and_session
+    with RpcClient(server.address, timeout=5) as c:
+        spec = c.call("get_cluster_spec")
+        assert spec == {"complete": False, "spec": {}, "callback_info": {}}
+        c.call("register_worker_spec", job_type="worker", index=0,
+               host="127.0.0.1", port=4000)
+        assert not c.call("get_cluster_spec")["complete"]
+        c.call("register_worker_spec", job_type="worker", index=1,
+               host="127.0.0.1", port=4001)
+        spec = c.call("get_cluster_spec")
+        assert spec["complete"]
+        assert spec["spec"] == {"worker": ["127.0.0.1:4000", "127.0.0.1:4001"]}
+        # Barrier passed -> tasks RUNNING.
+        infos = c.call("get_task_infos")
+        assert all(i["status"] == "RUNNING" for i in infos)
+
+
+def test_all_registered_fires_once(server_and_session):
+    server, handler, session = server_and_session
+    fired = []
+    handler.on_all_registered = lambda: fired.append(1)
+    with RpcClient(server.address, timeout=5) as c:
+        c.call("register_worker_spec", job_type="worker", index=0,
+               host="h", port=1)
+        c.call("register_worker_spec", job_type="worker", index=1,
+               host="h", port=2)
+        # Re-registration (executor restart) must not re-fire the barrier.
+        c.call("register_worker_spec", job_type="worker", index=1,
+               host="h", port=2)
+    assert fired == [1]
+
+
+def test_result_heartbeat_metrics_and_status(server_and_session):
+    server, handler, session = server_and_session
+    with RpcClient(server.address, timeout=5) as c:
+        c.call("register_worker_spec", job_type="worker", index=0, host="h", port=1)
+        c.call("register_worker_spec", job_type="worker", index=1, host="h", port=2)
+        assert c.call("heartbeat", job_type="worker", index=0) is True
+        c.call("metrics_report", job_type="worker", index=0,
+               metrics={"cpu_pct": 12.5, "rss_mb": 100})
+        assert session.task("worker", 0).metrics["cpu_pct"] == 12.5
+        c.call("register_execution_result", job_type="worker", index=0,
+               exit_code=0)
+        c.call("register_execution_result", job_type="worker", index=1,
+               exit_code=0)
+        status = c.call("get_job_status")
+        assert status["status"] == "SUCCEEDED"
+
+
+def test_error_transport(server_and_session):
+    server, _, _ = server_and_session
+    with RpcClient(server.address, timeout=5) as c:
+        with pytest.raises(RpcError, match="unknown RPC method"):
+            c.call("no_such_method")
+        with pytest.raises(RpcError, match="KeyError"):
+            c.call("heartbeat", job_type="worker", index=99)
+
+
+def test_token_auth():
+    conf = TonyConfig({"tony.worker.instances": "1"})
+    session = TonySession(conf, app_id="app_tok_0001")
+    server = RpcServer(ApplicationRpcHandler(session), host="127.0.0.1",
+                       token="s3cret").start()
+    try:
+        with RpcClient(server.address, token="wrong", timeout=5) as c:
+            with pytest.raises(RpcError, match="token"):
+                c.call("get_cluster_spec")
+        with RpcClient(server.address, token="s3cret", timeout=5) as c:
+            assert c.call("get_cluster_spec")["complete"] is False
+    finally:
+        server.stop()
+
+
+def test_client_retries_until_server_up():
+    conf = TonyConfig({"tony.worker.instances": "1"})
+    session = TonySession(conf, app_id="app_retry_0001")
+    handler = ApplicationRpcHandler(session)
+    # Pre-bind to learn the port, start serving shortly after the first call.
+    server = RpcServer(handler, host="127.0.0.1")
+    t = threading.Timer(0.4, server.start)
+    t.start()
+    try:
+        with RpcClient(server.address, timeout=10) as c:
+            assert c.call("get_cluster_spec")["complete"] is False
+    finally:
+        t.join()
+        server.stop()
+
+
+def test_finish_application_kills(server_and_session):
+    server, _, session = server_and_session
+    with RpcClient(server.address, timeout=5) as c:
+        c.call("finish_application", reason="user ctrl-c")
+    assert session.job_status is JobStatus.KILLED
+    assert all(t.status.value == "KILLED" for t in session.tasks())

@@ -1,0 +1,7 @@
+"""Stub workload: dump the env the executor built into ./env.json
+(reference fixture: check_env_and_venv.py)."""
+import json
+import os
+
+with open("env.json", "w") as f:
+    json.dump(dict(os.environ), f)

@@ -1,0 +1,11 @@
+"""Fails on the first attempt, succeeds on the second (AM gang-restart test).
+The marker lives in the STAGED src dir (shared across attempts), not the
+per-container copy, so attempt 2 sees attempt 1's marker."""
+import os
+import sys
+
+marker = os.path.join(os.environ["TONY_SRC_DIR"], "flaky.marker")
+if os.path.exists(marker):
+    sys.exit(0)
+open(marker, "w").close()
+sys.exit(1)

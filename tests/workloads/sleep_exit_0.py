@@ -1,0 +1,3 @@
+import time, sys
+time.sleep(3)
+sys.exit(0)

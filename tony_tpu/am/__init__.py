@@ -1,0 +1,308 @@
+"""ApplicationMaster: the scheduler brain (layer L4).
+
+Mirrors ``com.linkedin.tony.TonyApplicationMaster`` (upstream ``tony-core/src/
+main/java/com/linkedin/tony/TonyApplicationMaster.java`` ≈1,500 LoC,
+unverified — SURVEY.md §0, call stacks §3.1/§3.3). Responsibilities carried
+over, re-mapped from YARN to the :mod:`tony_tpu.scheduler` substrate:
+
+* translate per-jobtype config into container launches (gang allocation);
+* serve the control-plane RPC (register / cluster-spec / heartbeat /
+  result / metrics) to executors;
+* the monitor loop: heartbeat-expiry → LOST, completed-container handling,
+  preemption re-request (``tony.container.preemption.max-retries``), gang
+  allocation timeout, application timeout;
+* success policy via :class:`~tony_tpu.session.TonySession`;
+* AM-attempt gang restart (``tony.am.retry-count``) — `jax.distributed` is
+  unforgiving about world membership (SURVEY.md §7 hard part #1), so a retry
+  tears down the WHOLE gang and relaunches with ``attempt_id + 1``;
+* lifecycle event emission to the jhist log (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import sys
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from tony_tpu import conf as conf_mod
+from tony_tpu import constants
+from tony_tpu.conf import TonyConfig
+from tony_tpu.events import EventHandler
+from tony_tpu.rpc import ENV_JOB_TOKEN, ApplicationRpcHandler, RpcServer
+from tony_tpu.scheduler import (Container, ContainerLaunch,
+                                ContainerScheduler, LocalProcessScheduler)
+from tony_tpu.session import JobStatus, TaskStatus, TonySession
+
+AM_ADDRESS_FILE = "am.address"
+AM_TOKEN_FILE = "am.token"
+FINAL_STATUS_FILE = "final-status.json"
+_TICK_S = 0.05
+
+
+class ApplicationMaster:
+    """One AM process/thread: owns the RPC server, the scheduler client, the
+    session, and the monitor loop."""
+
+    def __init__(self, conf: TonyConfig, app_id: str, job_dir: str | Path,
+                 scheduler: Optional[ContainerScheduler] = None,
+                 host: str = "127.0.0.1", quiet: bool = True):
+        self.conf = conf
+        self.app_id = app_id
+        # Resolve: executors run with a different cwd, so every path shipped
+        # to them (conf, src) must be absolute.
+        self.job_dir = Path(job_dir).resolve()
+        self.job_dir.mkdir(parents=True, exist_ok=True)
+        self.scheduler = scheduler or LocalProcessScheduler(
+            self.job_dir, host=host, conf=conf)
+        self.host = host
+        self.quiet = quiet
+        self.token: Optional[str] = None
+        if conf.get_bool(conf_mod.SECURITY_ENABLED, False):
+            self.token = secrets.token_hex(16)
+            token_path = self.job_dir / AM_TOKEN_FILE
+            token_path.write_text(self.token)
+            token_path.chmod(0o600)
+        from tony_tpu.runtime import get_framework
+        self.framework = get_framework(
+            conf.get(conf_mod.APPLICATION_FRAMEWORK, "jax"))
+        self.session: Optional[TonySession] = None
+        self.server: Optional[RpcServer] = None
+        self.handler: Optional[ApplicationRpcHandler] = None
+        self.events: Optional[EventHandler] = None
+        self._containers: Dict[str, Container] = {}   # task_id -> live container
+        self.final_status = JobStatus.FAILED
+        self.final_message = ""
+
+    def _log(self, msg: str) -> None:
+        if not self.quiet:
+            print(f"[tony-am {self.app_id}] {msg}", file=sys.stderr, flush=True)
+
+    # -- container plumbing ------------------------------------------------
+    def _launch_task(self, session: TonySession, job_type: str,
+                     index: int) -> None:
+        req = self.conf.container_request(job_type)
+        env = {
+            constants.ENV_JOB_NAME: job_type,
+            constants.ENV_TASK_INDEX: str(index),
+            constants.ENV_TASK_NUM: str(session.num_tasks()),
+            # The REACHABLE address (matches the am.address file), not
+            # RpcServer.address which maps a 0.0.0.0 bind to loopback and
+            # would strand remote executors.
+            constants.ENV_AM_ADDRESS: f"{self.host}:{self.server.port}",  # type: ignore[union-attr]
+            constants.ENV_APP_ID: self.app_id,
+            constants.ENV_ATTEMPT_ID: str(session.attempt_id),
+            constants.ENV_CONF_PATH: str(self.job_dir / constants.TONY_JOB_JSON),
+        }
+        src = self.job_dir / "src"
+        if src.is_dir():
+            env[constants.ENV_SRC_DIR] = str(src)
+        if self.token:
+            env[ENV_JOB_TOKEN] = self.token
+        container = self.scheduler.launch(ContainerLaunch(
+            job_type=job_type, index=index, env=env,
+            memory_mb=req.memory_mb, vcores=req.vcores, tpus=req.tpus))
+        task = session.task(job_type, index)
+        with session.lock:
+            task.container_id = container.container_id
+            if not task.status.is_terminal:
+                task.status = TaskStatus.ALLOCATED
+            task.touch()
+        self._containers[task.task_id] = container
+        self._log(f"launched {task.task_id} in {container.container_id}")
+
+    def _stop_task_containers(self, session: TonySession) -> None:
+        for task in session.tasks():
+            c = self._containers.get(task.task_id)
+            if c is not None and c.is_running:
+                self.scheduler.stop_container(c)
+
+    # -- monitor-loop checks ----------------------------------------------
+    def _check_heartbeats(self, session: TonySession) -> None:
+        interval_s = self.conf.get_int(
+            conf_mod.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1e3
+        max_missed = self.conf.get_int(conf_mod.TASK_MAX_MISSED_HEARTBEATS, 25)
+        expiry = interval_s * max_missed
+        now = time.monotonic()
+        for task in session.tasks():
+            if task.status in (TaskStatus.REGISTERED, TaskStatus.RUNNING) \
+                    and task.last_heartbeat \
+                    and now - task.last_heartbeat > expiry:
+                self._log(f"task {task.task_id} missed {max_missed} "
+                          f"heartbeats -> LOST")
+                session.on_task_lost(
+                    task, f"missed {max_missed} heartbeats "
+                          f"({expiry:.1f}s without contact)")
+                c = self._containers.get(task.task_id)
+                if c is not None and c.is_running:
+                    self.scheduler.stop_container(c)
+
+    def _handle_completed_containers(self, session: TonySession) -> None:
+        max_preempt = self.conf.get_int(conf_mod.PREEMPTION_MAX_RETRIES, 3)
+        for c in self.scheduler.poll_completed():
+            task = session.task_by_container(c.container_id)
+            if task is None:
+                continue
+            live = self._containers.get(task.task_id)
+            if live is not None and live.container_id == c.container_id:
+                del self._containers[task.task_id]
+            if task.status.is_terminal:
+                continue
+            if c.exit_code == constants.EXIT_PREEMPTED:
+                task.preemption_retries += 1
+                if task.preemption_retries <= max_preempt:
+                    self._log(f"{task.task_id} preempted "
+                              f"(retry {task.preemption_retries}/{max_preempt})"
+                              f" -> re-requesting container")
+                    with session.lock:
+                        task.host = task.port = None
+                        task.status = TaskStatus.REQUESTED
+                    self._launch_task(session, task.job_type, task.index)
+                else:
+                    session.on_task_result(
+                        task.job_type, task.index, constants.EXIT_PREEMPTED,
+                        f"preempted {task.preemption_retries} times "
+                        f"(max {max_preempt})")
+            else:
+                # Executor died without a result RPC (crash, OOM-kill).
+                session.on_task_result(
+                    task.job_type, task.index,
+                    c.exit_code if c.exit_code else constants.EXIT_FAILURE,
+                    f"executor exited with {c.exit_code} without reporting")
+
+    # -- one attempt -------------------------------------------------------
+    def run_attempt(self, attempt_id: int) -> JobStatus:
+        conf = self.conf
+        session = TonySession(conf, self.app_id, attempt_id=attempt_id)
+        self.session = session
+        am_adapter = self.framework.am_adapter()
+        am_adapter.validate_and_update_config(conf)
+        am_adapter.set_session(session)
+        if self.handler is None:
+            self.handler = ApplicationRpcHandler(session)
+        else:
+            self.handler.reset(session)
+        handler = self.handler
+
+        def on_all_registered() -> None:
+            am_adapter.on_all_registered()
+            handler.callback_info.update(am_adapter.callback_info())
+            self._log("gang barrier passed: all tasks registered")
+
+        handler.on_all_registered = on_all_registered
+        if self.events is not None:
+            handler.on_registered = (
+                lambda jt, i: self.events.task_started(
+                    jt, i, session.task(jt, i).host or ""))
+        if self.server is None:
+            self.server = RpcServer(handler, host="0.0.0.0",
+                                    token=self.token).start()
+            # Advertise the reachable address, not the bind-all one.
+            (self.job_dir / AM_ADDRESS_FILE).write_text(
+                f"{self.host}:{self.server.port}")
+        if self.events is not None:
+            self.events.application_inited(attempt_id, session.num_tasks())
+
+        self._containers.clear()
+        start = time.monotonic()
+        gang_timeout_s = conf.get_int(conf_mod.AM_GANG_TIMEOUT_MS, 120000) / 1e3
+        app_timeout_s = conf.get_int(conf_mod.APPLICATION_TIMEOUT, 0) / 1e3
+        pending = [(jt, i) for jt in conf.job_types()
+                   for i in range(conf.instances(jt))]
+        try:
+            while True:
+                # Launch whatever the adapter allows (Horovod gates workers
+                # on its driver being up — ``canStartTask``).
+                still_pending = []
+                for jt, i in pending:
+                    if am_adapter.can_start_task(jt, i):
+                        self._launch_task(session, jt, i)
+                    else:
+                        still_pending.append((jt, i))
+                pending = still_pending
+
+                self._handle_completed_containers(session)
+                self._check_heartbeats(session)
+
+                # Gang timeout applies only before the first barrier pass —
+                # a preemption relaunch transiently un-registers one task and
+                # must not trip it.
+                if not handler._all_registered_fired and \
+                        time.monotonic() - start > gang_timeout_s:
+                    with session.lock:
+                        for t in session.tasks():
+                            if t.spec is None and not t.status.is_terminal:
+                                session.on_task_lost(
+                                    t, f"not registered within gang timeout "
+                                       f"({gang_timeout_s:.0f}s)")
+                        if session.job_status == JobStatus.RUNNING:
+                            session.job_status = JobStatus.FAILED
+                            session.final_message = "gang allocation timed out"
+                if app_timeout_s and time.monotonic() - start > app_timeout_s:
+                    with session.lock:
+                        if session.job_status == JobStatus.RUNNING:
+                            session.job_status = JobStatus.FAILED
+                            session.final_message = (
+                                f"application exceeded "
+                                f"tony.application.timeout-ms")
+                if session.is_done():
+                    break
+                time.sleep(_TICK_S)
+        finally:
+            # Teardown: untracked sidecars and any stragglers die with the job.
+            session.kill_remaining(
+                f"job finished: {session.job_status.value}")
+            self._stop_task_containers(session)
+            self.scheduler.poll_completed()
+            am_adapter.stop()
+            if self.events is not None:
+                for t in session.tasks():
+                    self.events.task_finished(
+                        t.job_type, t.index, t.status.value, t.exit_code,
+                        t.diagnostics, t.metrics)
+        self._log(f"attempt {attempt_id}: {session.job_status.value} "
+                  f"- {session.final_message}")
+        return session.job_status
+
+    # -- whole application -------------------------------------------------
+    def run(self) -> int:
+        conf = self.conf
+        conf.validate()
+        conf.save(self.job_dir / constants.TONY_JOB_JSON)
+        history = conf.get(conf_mod.HISTORY_LOCATION) or str(
+            self.job_dir / "history")
+        self.events = EventHandler(
+            history, self.app_id,
+            conf_snapshot=dict(conf.items()),
+            app_name=conf.get(conf_mod.APPLICATION_NAME, ""))
+        retries = conf.get_int(conf_mod.AM_RETRY_COUNT, 0)
+        status = JobStatus.FAILED
+        try:
+            for attempt in range(1, retries + 2):
+                status = self.run_attempt(attempt)
+                if status in (JobStatus.SUCCEEDED, JobStatus.KILLED):
+                    break
+                if attempt <= retries:
+                    self._log(f"attempt {attempt} failed; gang restart "
+                              f"({attempt}/{retries} retries used)")
+        finally:
+            self.final_status = status
+            self.final_message = (self.session.final_message
+                                  if self.session else "")
+            self.events.application_finished(status.value, self.final_message)
+            self.events.close()
+            (self.job_dir / FINAL_STATUS_FILE).write_text(
+                json.dumps({
+                    "status": status.value,
+                    "message": self.final_message,
+                    "app_id": self.app_id,
+                }))
+            self.scheduler.stop()
+            if self.server is not None:
+                # Give the client one last poll window before the socket dies.
+                time.sleep(0.1)
+                self.server.stop()
+        return (constants.EXIT_SUCCESS if status == JobStatus.SUCCEEDED
+                else constants.EXIT_FAILURE)

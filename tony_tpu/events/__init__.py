@@ -1,0 +1,151 @@
+"""Lifecycle event log: the jhist write/read path.
+
+Mirrors ``com.linkedin.tony.events`` (``EventHandler`` + the Avro ``Event``
+schema under ``tony-core/src/main/avro/``, unverified — SURVEY.md §0/§3.5).
+The reference buffers Avro records and writes ``<appId>.jhist`` to an HDFS
+intermediate dir, moving it to the finished dir on completion; here the
+serialization is JSON-lines (SURVEY.md §7 design stance: "JSON-lines events
+instead of Avro jhist — same producer/consumer split") and the store is a
+plain directory tree::
+
+    <history>/intermediate/<appId>.jhist.inprogress   (while running)
+    <history>/finished/<appId>.jhist                  (after completion)
+
+Event types carried over: APPLICATION_INITED, TASK_STARTED, TASK_FINISHED,
+APPLICATION_FINISHED. The first line of every jhist file is a metadata record
+(user, app name, started timestamp, config snapshot) so the history server
+can render a job without re-reading its config files.
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional
+
+from tony_tpu import constants
+
+APPLICATION_INITED = "APPLICATION_INITED"
+TASK_STARTED = "TASK_STARTED"
+TASK_FINISHED = "TASK_FINISHED"
+APPLICATION_FINISHED = "APPLICATION_FINISHED"
+
+_METADATA = "METADATA"
+
+
+class EventHandler:
+    """Append-only jhist writer owned by the AM (reference: ``EventHandler``
+    producer thread; here writes are cheap enough to do inline under a lock)."""
+
+    def __init__(self, history_dir: str | Path, app_id: str,
+                 conf_snapshot: Optional[Dict[str, str]] = None,
+                 app_name: str = ""):
+        self.history_dir = Path(history_dir)
+        self.app_id = app_id
+        self._lock = threading.Lock()
+        inter = self.history_dir / constants.EVENTS_DIR_INTERMEDIATE
+        inter.mkdir(parents=True, exist_ok=True)
+        self.inprogress_path = inter / (
+            app_id + constants.JHIST_INPROGRESS_SUFFIX)
+        self.finished_path = (self.history_dir / constants.EVENTS_DIR_FINISHED
+                              / (app_id + constants.JHIST_SUFFIX))
+        self._file = open(self.inprogress_path, "a", encoding="utf-8")
+        self._closed = False
+        self._write({
+            "type": _METADATA,
+            "timestamp": time.time(),
+            "payload": {
+                "app_id": app_id,
+                "app_name": app_name,
+                "user": getpass.getuser(),
+                "started": time.time(),
+                "config": dict(conf_snapshot or {}),
+            },
+        })
+
+    def _write(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._file.write(json.dumps(record, sort_keys=True) + "\n")
+            self._file.flush()
+
+    def emit(self, event_type: str, **payload: Any) -> None:
+        self._write({"type": event_type, "timestamp": time.time(),
+                     "payload": payload})
+
+    # -- convenience emitters matching the reference's event vocabulary ----
+    def application_inited(self, attempt_id: int, num_tasks: int) -> None:
+        self.emit(APPLICATION_INITED, attempt_id=attempt_id,
+                  num_tasks=num_tasks)
+
+    def task_started(self, job_type: str, index: int, host: str) -> None:
+        self.emit(TASK_STARTED, job_type=job_type, index=index, host=host)
+
+    def task_finished(self, job_type: str, index: int, status: str,
+                      exit_code: Optional[int], diagnostics: str = "",
+                      metrics: Optional[Dict[str, float]] = None) -> None:
+        self.emit(TASK_FINISHED, job_type=job_type, index=index,
+                  status=status, exit_code=exit_code,
+                  diagnostics=diagnostics, metrics=metrics or {})
+
+    def application_finished(self, status: str, message: str = "") -> None:
+        self.emit(APPLICATION_FINISHED, status=status, message=message)
+
+    def close(self) -> None:
+        """Finalize: move intermediate → finished (the reference's HDFS
+        rename on job completion)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._file.close()
+        self.finished_path.parent.mkdir(parents=True, exist_ok=True)
+        os.replace(self.inprogress_path, self.finished_path)
+
+
+# ---------------------------------------------------------------------------
+# Read path (consumed by the history server and by tests)
+# ---------------------------------------------------------------------------
+
+def read_events(path: str | Path) -> List[Dict[str, Any]]:
+    """Parse one jhist (or .inprogress) file into its event records."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def job_metadata(path: str | Path) -> Dict[str, Any]:
+    """The metadata record (first line) of a jhist file."""
+    with open(path, encoding="utf-8") as f:
+        first = f.readline().strip()
+    rec = json.loads(first) if first else {}
+    return rec.get("payload", {}) if rec.get("type") == _METADATA else {}
+
+
+def list_jobs(history_dir: str | Path) -> Iterator[Dict[str, Any]]:
+    """All jobs under a history root, finished first then in-progress —
+    the history server's scan (reference: HDFS scan in ParserUtils)."""
+    root = Path(history_dir)
+    for sub, suffix, state in (
+            (constants.EVENTS_DIR_FINISHED, constants.JHIST_SUFFIX, "finished"),
+            (constants.EVENTS_DIR_INTERMEDIATE,
+             constants.JHIST_INPROGRESS_SUFFIX, "running")):
+        d = root / sub
+        if not d.is_dir():
+            continue
+        for p in sorted(d.iterdir()):
+            if not p.name.endswith(suffix):
+                continue
+            app_id = p.name[:-len(suffix)]
+            meta = job_metadata(p)
+            yield {"app_id": app_id, "state": state, "path": str(p),
+                   "metadata": meta}

@@ -1,0 +1,296 @@
+"""TaskExecutor: the in-container bootstrap around the user process.
+
+Mirrors ``com.linkedin.tony.TaskExecutor`` + ``TaskMonitor`` (upstream
+``tony-core/src/main/java/com/linkedin/tony/TaskExecutor.java`` ≈600 LoC /
+``TaskMonitor.java`` ≈400 LoC, unverified — SURVEY.md §0, call stack §3.2).
+Sequence, faithfully carried over:
+
+1. read the AM→executor env contract (job type, index, AM address, conf path);
+2. reserve the rendezvous port (and the TensorBoard port when the adapter
+   asks) via a held listening socket — the reference's ``ServerSocket`` trick;
+3. ``register_worker_spec`` over RPC;
+4. poll ``get_cluster_spec`` until the AM has ALL registrations (gang barrier);
+5. build the framework env via the runtime adapter (``TF_CONFIG``, the JAX
+   coordinator triple, …), localize ``src_dir`` into the container workdir;
+6. release the reserved sockets, fork the user process, pump its output to
+   the container log;
+7. heartbeat + metrics threads while the user process runs;
+8. ``register_execution_result`` and exit with the user's exit code.
+
+The metrics monitor samples ``/proc`` (cpu%/rss) instead of parsing
+``nvidia-smi`` — chip utilization on TPU comes from the profiler hook, not a
+sidecar CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from tony_tpu import constants
+from tony_tpu import conf as conf_mod
+from tony_tpu.conf import TonyConfig
+from tony_tpu.rpc import ENV_JOB_TOKEN, RpcClient
+from tony_tpu.runtime import TaskContext, get_framework
+
+
+def reserve_port(host: str = "") -> socket.socket:
+    """Bind a listening socket on an ephemeral port and keep it open —
+    the reference's ServerSocket reservation. Caller closes just before the
+    user process needs to bind the port itself."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    s.listen(1)
+    return s
+
+
+class TaskMonitor:
+    """Samples the user process from /proc on ``tony.task.metrics-interval-ms``
+    and ships ``{cpu_pct, rss_mb, uptime_s}`` to the AM (reference:
+    ``TaskMonitor`` → ``MetricsRpc``)."""
+
+    def __init__(self, pid: int, client: RpcClient, job_type: str, index: int,
+                 interval_s: float):
+        self.pid = pid
+        self.client = client
+        self.job_type = job_type
+        self.index = index
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="task-monitor")
+        self._start_time = time.monotonic()
+        self._last_cpu: Optional[tuple[float, float]] = None  # (cpu_s, wall)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def sample(self) -> Optional[Dict[str, float]]:
+        try:
+            with open(f"/proc/{self.pid}/stat") as f:
+                fields = f.read().rsplit(") ", 1)[1].split()
+            utime, stime = int(fields[11]), int(fields[12])
+            with open(f"/proc/{self.pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+        except (OSError, IndexError, ValueError):
+            return None
+        hz = os.sysconf("SC_CLK_TCK")
+        page = os.sysconf("SC_PAGE_SIZE")
+        cpu_s = (utime + stime) / hz
+        now = time.monotonic()
+        cpu_pct = 0.0
+        if self._last_cpu is not None:
+            prev_cpu, prev_wall = self._last_cpu
+            dt = now - prev_wall
+            if dt > 0:
+                cpu_pct = 100.0 * (cpu_s - prev_cpu) / dt
+        self._last_cpu = (cpu_s, now)
+        return {
+            "cpu_pct": round(cpu_pct, 2),
+            "rss_mb": round(rss_pages * page / (1024 * 1024), 2),
+            "uptime_s": round(now - self._start_time, 2),
+        }
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            m = self.sample()
+            if m is None:
+                return
+            try:
+                self.client.call("metrics_report", job_type=self.job_type,
+                                 index=self.index, metrics=m)
+            except Exception:
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class TaskExecutor:
+    """One executor lifecycle; :meth:`run` returns the exit code to die with."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None):
+        e = env if env is not None else os.environ
+        self.job_type = e[constants.ENV_JOB_NAME]
+        self.index = int(e[constants.ENV_TASK_INDEX])
+        self.am_address = e[constants.ENV_AM_ADDRESS]
+        self.app_id = e.get(constants.ENV_APP_ID, "app_unknown")
+        self.attempt_id = int(e.get(constants.ENV_ATTEMPT_ID, "1"))
+        self.conf = TonyConfig.load(e[constants.ENV_CONF_PATH])
+        self.host = e.get("TONY_EXECUTOR_HOST", "127.0.0.1")
+        self.src_dir = e.get(constants.ENV_SRC_DIR) or None
+        self.log_dir = Path(e.get(constants.ENV_LOG_DIR, "."))
+        self.token = e.get(ENV_JOB_TOKEN) or None
+        self.client = RpcClient(self.am_address, token=self.token,
+                                timeout=60.0)
+        self.framework = get_framework(
+            self.conf.get(conf_mod.APPLICATION_FRAMEWORK, "jax"))
+        self.user_proc: Optional[subprocess.Popen] = None
+        self._hb_stop = threading.Event()
+
+    # -- pieces ------------------------------------------------------------
+    def user_command(self) -> str:
+        cmd = (self.conf.get(conf_mod.command_key(self.job_type))
+               or self.conf.get("tony.application.executes"))
+        if not cmd:
+            raise RuntimeError(
+                f"no command for task {self.job_type}:{self.index}: set "
+                f"tony.application.executes or tony.{self.job_type}.command")
+        return cmd
+
+    def localize_src(self) -> Optional[Path]:
+        """Per-container copy of the staged src dir (reference:
+        ``LocalizableResource`` download into the container sandbox)."""
+        if not self.src_dir or not Path(self.src_dir).is_dir():
+            return None
+        dest = Path.cwd() / "src"
+        if not dest.exists():
+            shutil.copytree(self.src_dir, dest)
+        return dest
+
+    def _heartbeat_loop(self, interval_s: float) -> None:
+        while not self._hb_stop.wait(interval_s):
+            try:
+                self.client.call("heartbeat", job_type=self.job_type,
+                                 index=self.index)
+            except Exception:
+                return
+
+    def run(self) -> int:
+        conf = self.conf
+        # 1-2. reserve ports.
+        rendezvous_sock = reserve_port()
+        port = rendezvous_sock.getsockname()[1]
+        adapter = self.framework.task_adapter()
+        pre_ctx = TaskContext(conf=conf, job_type=self.job_type,
+                              index=self.index, cluster_spec={},
+                              am_address=self.am_address, app_id=self.app_id,
+                              attempt_id=self.attempt_id)
+        tb_sock = None
+        tb_port = None
+        if adapter.need_reserve_tb_port(pre_ctx):
+            tb_sock = reserve_port()
+            tb_port = tb_sock.getsockname()[1]
+        # 3. register.
+        self.client.call("register_worker_spec", job_type=self.job_type,
+                         index=self.index, host=self.host, port=port)
+        # 4. gang barrier.
+        gang_timeout_s = conf.get_int(conf_mod.AM_GANG_TIMEOUT_MS, 120000) / 1e3
+        deadline = time.monotonic() + gang_timeout_s
+        hb_interval_s = conf.get_int(
+            conf_mod.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1e3
+        hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(hb_interval_s,),
+            daemon=True, name="heartbeat")
+        hb_thread.start()
+        try:
+            while True:
+                resp = self.client.call("get_cluster_spec")
+                if resp["complete"]:
+                    cluster_spec = resp["spec"]
+                    callback_info = resp.get("callback_info", {})
+                    break
+                if time.monotonic() > deadline:
+                    print(f"[tony-executor] gang barrier timed out after "
+                          f"{gang_timeout_s:.0f}s", file=sys.stderr)
+                    return constants.EXIT_FAILURE
+                time.sleep(0.1)
+            # 5. build env + localize.
+            ctx = TaskContext(conf=conf, job_type=self.job_type,
+                              index=self.index, cluster_spec=cluster_spec,
+                              am_address=self.am_address, app_id=self.app_id,
+                              attempt_id=self.attempt_id, tb_port=tb_port,
+                              callback_info=callback_info)
+            adapter.validate(ctx)
+            task_env = adapter.build_task_env(ctx)
+            src = self.localize_src()
+            cmd = self.user_command()
+            env = dict(os.environ)
+            env.update(task_env)
+            if self.token:
+                env[ENV_JOB_TOKEN] = self.token
+            cwd = str(src) if src else os.getcwd()
+            pypath = [p for p in (cwd, env.get("PYTHONPATH")) if p]
+            env["PYTHONPATH"] = os.pathsep.join(pypath)
+            # 6. release reserved ports, launch the user process.
+            rendezvous_sock.close()
+            if tb_sock is not None:
+                tb_sock.close()
+            stdout = open(self.log_dir / constants.USER_STDOUT_NAME, "ab")
+            stderr = open(self.log_dir / constants.USER_STDERR_NAME, "ab")
+            self.user_proc = subprocess.Popen(
+                cmd, shell=True, env=env, cwd=cwd,
+                stdout=stdout, stderr=stderr)
+            stdout.close()
+            stderr.close()
+            if tb_port is not None and self.job_type in (
+                    constants.TENSORBOARD, *constants.CHIEF_LIKE_JOB_TYPES):
+                try:
+                    self.client.call("register_tensorboard_url",
+                                     url=f"http://{self.host}:{tb_port}")
+                except Exception:
+                    pass
+            # 7. metrics monitor.
+            metrics_interval_s = conf.get_int(
+                conf_mod.TASK_METRICS_INTERVAL_MS, 5000) / 1e3
+            monitor = TaskMonitor(self.user_proc.pid, self.client,
+                                  self.job_type, self.index,
+                                  metrics_interval_s)
+            monitor.start()
+            # 8. wait (with optional execution timeout), report, exit.
+            timeout_ms = conf.get_int(
+                conf_mod.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS, 0)
+            diagnostics = ""
+            try:
+                exit_code = self.user_proc.wait(
+                    timeout=timeout_ms / 1e3 if timeout_ms else None)
+            except subprocess.TimeoutExpired:
+                self.user_proc.kill()
+                self.user_proc.wait()
+                exit_code = constants.EXIT_FAILURE
+                diagnostics = f"execution timed out after {timeout_ms}ms"
+            monitor.stop()
+            try:
+                self.client.call("register_execution_result",
+                                 job_type=self.job_type, index=self.index,
+                                 exit_code=exit_code, diagnostics=diagnostics)
+            except Exception as e:
+                print(f"[tony-executor] result RPC failed: {e}",
+                      file=sys.stderr)
+            return exit_code
+        finally:
+            self._hb_stop.set()
+            for s in (rendezvous_sock, tb_sock):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            if self.user_proc is not None and self.user_proc.poll() is None:
+                self.user_proc.kill()
+            self.client.close()
+
+
+def main() -> int:
+    try:
+        executor = TaskExecutor()
+    except Exception as e:  # bad env/conf: fail loudly before any RPC
+        print(f"[tony-executor] bootstrap failed: {e}", file=sys.stderr)
+        return constants.EXIT_FAILURE
+    # Forward SIGTERM (scheduler stop) to the user process so it can die fast.
+    def _on_term(signum, frame):
+        if executor.user_proc is not None and executor.user_proc.poll() is None:
+            executor.user_proc.kill()
+        sys.exit(constants.EXIT_KILLED)
+    signal.signal(signal.SIGTERM, _on_term)
+    return executor.run()

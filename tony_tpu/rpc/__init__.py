@@ -1,0 +1,290 @@
+"""Control-plane RPC: the AM↔executor (and client↔AM) wire.
+
+Mirrors the role of ``com.linkedin.tony.rpc`` (upstream ``tony-core/src/main/
+java/com/linkedin/tony/rpc/`` — ``ApplicationRpc``/``ApplicationRpcServer``/
+``ApplicationRpcClient`` + ``MetricsRpc``, unverified, SURVEY.md §0). The
+reference uses Hadoop RPC over protobuf; the verbs are what matter
+(SURVEY.md §2.1 "Control-plane RPC"), not the wire, so this implementation is
+newline-delimited JSON over TCP: zero codegen, stdlib-only, debuggable with
+``nc``. The protocol verbs carried over:
+
+    register_worker_spec, get_cluster_spec, taskExecutorHeartbeat→heartbeat,
+    register_execution_result, get_task_infos, register_tensorboard_url,
+    register_callback_info, metrics_report (MetricsRpc), get_job_status,
+    finish_application
+
+Security: when ``tony.security.enabled`` is true the client must present the
+job token (shipped to executors via env — the moral equivalent of the
+reference's ClientToAMToken); mismatches are rejected before dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+# Env var carrying the job token to executors (security.enabled only).
+ENV_JOB_TOKEN = "TONY_JOB_TOKEN"
+
+
+class RpcError(Exception):
+    """Remote call failed: transported application-level error."""
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server: RpcServer = self.server  # type: ignore[assignment]
+        while True:
+            try:
+                line = self.rfile.readline()
+            except OSError:
+                return
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+                method = req["method"]
+                params = req.get("params") or {}
+                if server.token and req.get("token") != server.token:
+                    resp = {"ok": False, "error": "invalid job token"}
+                else:
+                    fn = server.lookup(method)
+                    result = fn(**params)
+                    resp = {"ok": True, "result": result}
+            except RpcError as e:
+                resp = {"ok": False, "error": str(e)}
+            except Exception as e:  # noqa: BLE001 — transported to caller
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            try:
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+            except OSError:
+                return
+
+
+class RpcServer:
+    """Threaded JSON-lines RPC server dispatching to ``rpc_<method>``
+    callables on a handler object (reference: ``ApplicationRpcServer``)."""
+
+    def __init__(self, handler: object, host: str = "0.0.0.0",
+                 port: int = 0, token: Optional[str] = None):
+        self._handler = handler
+        self.token = token
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=False)
+        self._tcp.allow_reuse_address = True
+        self._tcp.daemon_threads = True
+        self._tcp.server_bind()
+        self._tcp.server_activate()
+        self.host, self.port = self._tcp.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="tony-rpc", daemon=True)
+
+    # socketserver instantiates _Handler with the TCPServer as .server; give
+    # that object the lookup/token surface _Handler expects.
+    def start(self) -> "RpcServer":
+        self._tcp.lookup = self.lookup          # type: ignore[attr-defined]
+        self._tcp.token = self.token            # type: ignore[attr-defined]
+        self._thread.start()
+        return self
+
+    @property
+    def address(self) -> str:
+        host = self.host if self.host != "0.0.0.0" else "127.0.0.1"
+        return f"{host}:{self.port}"
+
+    def lookup(self, method: str) -> Callable[..., Any]:
+        fn = getattr(self._handler, f"rpc_{method}", None)
+        if fn is None or not callable(fn):
+            raise RpcError(f"unknown RPC method {method!r}")
+        return fn
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        self._thread.join(timeout=5)
+
+
+class RpcClient:
+    """Reconnecting JSON-lines RPC client (reference: ``ApplicationRpcClient``).
+
+    One persistent connection, re-dialed on failure; every call retries with
+    backoff up to ``timeout`` seconds — executors come up before the AM
+    socket is reachable in some orderings, and the reference's Hadoop RPC
+    retries the same way.
+    """
+
+    def __init__(self, address: str, token: Optional[str] = None,
+                 timeout: float = 30.0, retry_interval: float = 0.2):
+        host, _, port = address.rpartition(":")
+        self._addr = (host, int(port))
+        self.token = token
+        self.timeout = timeout
+        self.retry_interval = retry_interval
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> None:
+        self.close()
+        self._sock = socket.create_connection(self._addr, timeout=10.0)
+        self._file = self._sock.makefile("rwb")
+
+    def call(self, method: str, **params: Any) -> Any:
+        """Invoke ``method`` remotely; retries transport errors until
+        ``timeout``, raises :class:`RpcError` on application errors."""
+        req = {"method": method, "params": params}
+        if self.token:
+            req["token"] = self.token
+        payload = (json.dumps(req) + "\n").encode()
+        deadline = time.monotonic() + self.timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                with self._lock:
+                    if self._file is None:
+                        self._connect()
+                    assert self._file is not None
+                    self._file.write(payload)
+                    self._file.flush()
+                    line = self._file.readline()
+                if not line:
+                    raise ConnectionError("server closed connection")
+                resp = json.loads(line)
+                if resp.get("ok"):
+                    return resp.get("result")
+                raise RpcError(resp.get("error", "unknown remote error"))
+            except RpcError:
+                raise
+            except (OSError, ValueError, ConnectionError) as e:
+                last_err = e
+                with self._lock:
+                    self.close()
+                time.sleep(self.retry_interval)
+        raise ConnectionError(
+            f"RPC {method} to {self._addr} failed after {self.timeout}s: {last_err}")
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self) -> "RpcClient":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+
+class ApplicationRpcHandler:
+    """Server-side verb set bridging RPC to a :class:`TonySession` — the
+    reference's ``ApplicationRpc`` service implementation living inside the
+    AM (``TonyApplicationMaster`` implements these verbs against its session).
+
+    The AM subclasses/owns this and may hook extra behavior (events, adapter
+    callbacks) via the ``on_*`` callback slots.
+    """
+
+    def __init__(self, session):
+        self.session = session
+        self.callback_info: Dict[str, str] = {}
+        self.on_registered: Optional[Callable[[str, int], None]] = None
+        self.on_result: Optional[Callable[[str, int, int, str], None]] = None
+        self.on_all_registered: Optional[Callable[[], None]] = None
+        self._all_registered_fired = False
+        self._fire_lock = threading.Lock()
+
+    def reset(self, session) -> None:
+        """Point the handler at a fresh session (AM gang restart: the RPC
+        server survives across attempts, the session does not)."""
+        with self._fire_lock:
+            self.session = session
+            self.callback_info = {}
+            self._all_registered_fired = False
+
+    # -- executor-facing verbs --------------------------------------------
+    def rpc_register_worker_spec(self, job_type: str, index: int,
+                                 host: str, port: int) -> Dict[str, Any]:
+        self.session.on_registered(job_type, index, host, port)
+        if self.on_registered:
+            self.on_registered(job_type, index)
+        if self.session.all_registered():
+            # The once-only adapter callback runs under the lock BEFORE the
+            # barrier becomes visible to get_cluster_spec, so no executor can
+            # observe a complete spec with missing callback_info. A second
+            # pass (executor relaunch after preemption) re-marks RUNNING but
+            # does not re-fire the adapter.
+            with self._fire_lock:
+                if not self._all_registered_fired:
+                    if self.on_all_registered:
+                        self.on_all_registered()
+                    self._all_registered_fired = True
+            self.session.on_running()
+        return {"task_id": f"{job_type}:{index}"}
+
+    def rpc_get_cluster_spec(self) -> Dict[str, Any]:
+        complete = self._all_registered_fired and self.session.all_registered()
+        return {
+            "complete": complete,
+            "spec": self.session.cluster_spec() if complete else {},
+            "callback_info": dict(self.callback_info),
+        }
+
+    def rpc_heartbeat(self, job_type: str, index: int) -> bool:
+        self.session.on_heartbeat(job_type, index)
+        return True
+
+    def rpc_register_execution_result(self, job_type: str, index: int,
+                                      exit_code: int,
+                                      diagnostics: str = "") -> bool:
+        self.session.on_task_result(job_type, index, exit_code, diagnostics)
+        if self.on_result:
+            self.on_result(job_type, index, exit_code, diagnostics)
+        return True
+
+    def rpc_register_tensorboard_url(self, url: str) -> bool:
+        self.session.tensorboard_url = url
+        return True
+
+    def rpc_register_callback_info(self, task_id: str, payload: str) -> bool:
+        return True
+
+    def rpc_metrics_report(self, job_type: str, index: int,
+                           metrics: Dict[str, float]) -> bool:
+        task = self.session.task(job_type, index)
+        task.metrics.update({str(k): float(v) for k, v in metrics.items()})
+        return True
+
+    # -- client-facing verbs ----------------------------------------------
+    def rpc_get_task_infos(self) -> list:
+        return self.session.task_infos()
+
+    def rpc_get_job_status(self) -> Dict[str, Any]:
+        return {
+            "status": self.session.job_status.value,
+            "message": self.session.final_message,
+            "attempt_id": self.session.attempt_id,
+            "tensorboard_url": self.session.tensorboard_url,
+        }
+
+    def rpc_finish_application(self, reason: str = "killed by client") -> bool:
+        from tony_tpu.session import JobStatus
+        with self.session.lock:
+            if self.session.job_status == JobStatus.RUNNING:
+                self.session.job_status = JobStatus.KILLED
+                self.session.final_message = reason
+        self.session.kill_remaining(reason)
+        return True

@@ -23,6 +23,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, TYPE_CHECKING
 
+from tony_tpu import constants
+
 if TYPE_CHECKING:  # pragma: no cover
     from tony_tpu.conf import TonyConfig
     from tony_tpu.session import TonySession
@@ -51,12 +53,10 @@ class TaskContext:
         sidecars (tensorboard/notebook/driver). Rank assignment, world size
         and coordinator selection all run over these only — a configured
         sidecar must never become the coordinator or inflate WORLD_SIZE."""
-        from tony_tpu import constants
         return [jt for jt in self.job_types()
                 if jt not in constants.SIDECAR_JOB_TYPES]
 
     def is_sidecar(self) -> bool:
-        from tony_tpu import constants
         return self.job_type in constants.SIDECAR_JOB_TYPES
 
     def num_tasks(self) -> int:
@@ -127,7 +127,6 @@ class TaskExecutorAdapter:
     def need_reserve_tb_port(self, ctx: TaskContext) -> bool:
         """Whether this task should reserve a TensorBoard port (chief or a
         dedicated ``tensorboard`` task)."""
-        from tony_tpu import constants
         return ctx.job_type in (constants.TENSORBOARD,) or (
             ctx.job_type in constants.CHIEF_LIKE_JOB_TYPES and
             constants.TENSORBOARD not in ctx.job_types())

@@ -1,0 +1,306 @@
+"""Container scheduling substrate: the YARN-RM/NM replacement (layer L0).
+
+The reference delegates this layer entirely to Hadoop YARN (SURVEY.md §1 L0);
+the AM asks the RM for containers sized ``{memory, vcores, gpus}`` and the NM
+launches ``TaskExecutor`` JVMs. Here the same two verbs — allocate/launch and
+reap — sit behind :class:`ContainerScheduler`, with two backends:
+
+* :class:`LocalProcessScheduler` — containers are local subprocesses running
+  ``python -m tony_tpu.executor``. This is both the MiniPod test substrate
+  (the MiniYARNCluster analogue, SURVEY.md §4) and the single-host
+  production path on one TPU-VM.
+* :class:`TpuVmScheduler` — the multi-host pod-slice backend: same interface,
+  launches executors on remote TPU-VM workers (one per host) over SSH.
+  Resource semantics follow the ``yarn.io/tpu`` resource-type model from the
+  north star: a request carries ``tpus`` and the scheduler places tasks so
+  chip assignments never overlap (the JAXRuntime then pins
+  ``TPU_VISIBLE_DEVICES`` per task).
+
+Preemption is a first-class verb (``preempt``) because the reference's
+failure machinery distinguishes preempted containers (re-request) from
+crashed ones (fail-fast) — SURVEY.md §3.3.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from tony_tpu import constants
+
+
+@dataclass
+class ContainerLaunch:
+    """One container ask: which task, with what env (reference: the
+    ``ContainerLaunchContext`` the AM builds per matched allocation)."""
+    job_type: str
+    index: int
+    env: Dict[str, str]
+    memory_mb: int = 1024
+    vcores: int = 1
+    tpus: int = 0
+
+
+@dataclass
+class Container:
+    """A granted container and its lifecycle (reference: YARN ``Container`` +
+    completion status)."""
+    container_id: str
+    job_type: str
+    index: int
+    host: str
+    exit_code: Optional[int] = None
+    preempted: bool = False
+    _proc: Optional[subprocess.Popen] = field(default=None, repr=False)
+
+    @property
+    def is_running(self) -> bool:
+        return self.exit_code is None
+
+
+class ContainerScheduler:
+    """Substrate SPI: allocate-and-launch, reap, kill, preempt."""
+
+    def launch(self, launch: ContainerLaunch) -> Container:
+        raise NotImplementedError
+
+    def poll_completed(self) -> List[Container]:
+        """Containers that exited since the last poll (reference:
+        ``onContainersCompleted``)."""
+        raise NotImplementedError
+
+    def stop_container(self, container: Container) -> None:
+        raise NotImplementedError
+
+    def preempt(self, container_id: str) -> bool:
+        """Simulate/execute a scheduler preemption: the container dies and is
+        reported with ``preempted=True`` (reference: YARN exit status
+        ``PREEMPTED``). Returns False if the container is not running."""
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        """Tear down everything still running."""
+
+
+class LocalProcessScheduler(ContainerScheduler):
+    """Containers as local subprocesses (MiniYARNCluster analogue).
+
+    Each container gets a working directory ``<job_dir>/containers/<cid>``
+    and its executor stdout/stderr tee into ``executor.log`` there. Resource
+    numbers (memory/vcores) are recorded, not enforced — exactly like
+    MiniYARNCluster's default; ``tpus`` asks are validated against
+    ``total_tpus`` so over-subscription fails at launch, mirroring an RM
+    rejecting an unsatisfiable resource ask.
+    """
+
+    def __init__(self, job_dir: str | Path, host: str = "127.0.0.1",
+                 total_tpus: int = 0, conf=None):
+        self.job_dir = Path(job_dir)
+        self.host = host
+        self.conf = conf                      # for docker command wrapping
+        self.total_tpus = total_tpus          # 0 = unlimited (no TPU asks)
+        self._tpus_in_use = 0
+        self._lock = threading.Lock()
+        self._running: Dict[str, Container] = {}
+        self._next_id = 0
+
+    def _new_cid(self) -> str:
+        with self._lock:
+            self._next_id += 1
+            return f"container_{os.getpid()}_{self._next_id:04d}"
+
+    def launch(self, launch: ContainerLaunch) -> Container:
+        if self.total_tpus and launch.tpus:
+            with self._lock:
+                if self._tpus_in_use + launch.tpus > self.total_tpus:
+                    raise RuntimeError(
+                        f"unsatisfiable tpu ask: {launch.tpus} requested, "
+                        f"{self.total_tpus - self._tpus_in_use} free")
+                self._tpus_in_use += launch.tpus
+        cid = self._new_cid()
+        workdir = self.job_dir / "containers" / cid
+        workdir.mkdir(parents=True, exist_ok=True)
+        log = open(workdir / constants.EXECUTOR_LOG_NAME, "ab")
+        env = dict(os.environ)
+        env.update(launch.env)
+        env[constants.ENV_CONTAINER_ID] = cid
+        env.setdefault(constants.ENV_LOG_DIR, str(workdir))
+        env["TONY_EXECUTOR_HOST"] = self.host
+        # The executor subprocess must find tony_tpu even when the parent
+        # imported it off sys.path (tests) rather than an installed package.
+        pkg_root = str(Path(__file__).resolve().parent.parent.parent)
+        parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(
+            os.pathsep) if p and p != pkg_root]
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+        argv = [sys.executable, "-m", "tony_tpu.executor"]
+        if self.conf is not None:
+            argv = docker_wrap_command(self.conf, argv)
+        proc = subprocess.Popen(
+            argv, env=env, cwd=workdir, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        log.close()
+        c = Container(container_id=cid, job_type=launch.job_type,
+                      index=launch.index, host=self.host, _proc=proc)
+        c._tpus = launch.tpus  # type: ignore[attr-defined]
+        with self._lock:
+            self._running[cid] = c
+        return c
+
+    def poll_completed(self) -> List[Container]:
+        done = []
+        with self._lock:
+            for cid, c in list(self._running.items()):
+                rc = c._proc.poll() if c._proc else -1
+                if rc is not None:
+                    c.exit_code = (constants.EXIT_PREEMPTED if c.preempted
+                                   else rc)
+                    self._tpus_in_use -= getattr(c, "_tpus", 0)
+                    del self._running[cid]
+                    done.append(c)
+        return done
+
+    def stop_container(self, container: Container) -> None:
+        with self._lock:
+            c = self._running.get(container.container_id)
+        if c is not None and c._proc is not None and c._proc.poll() is None:
+            # Kill the whole process group: executor + its user child.
+            try:
+                os.killpg(c._proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def preempt(self, container_id: str) -> bool:
+        with self._lock:
+            c = self._running.get(container_id)
+        if c is None or c._proc is None or c._proc.poll() is not None:
+            return False
+        c.preempted = True
+        try:
+            os.killpg(c._proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            return False
+        return True
+
+    def running(self) -> List[Container]:
+        with self._lock:
+            return list(self._running.values())
+
+    def stop(self) -> None:
+        for c in self.running():
+            self.stop_container(c)
+        deadline = time.monotonic() + 5
+        while self.running() and time.monotonic() < deadline:
+            self.poll_completed()
+            time.sleep(0.05)
+
+
+def docker_wrap_command(conf, argv: List[str]) -> List[str]:
+    """When ``tony.docker.enabled`` is set, wrap an executor launch command in
+    ``docker run`` with the configured image (reference: the YARN docker
+    runtime env ``YARN_CONTAINER_RUNTIME_TYPE=docker`` — SURVEY.md §2.1
+    "Docker support"). Applied by ``LocalProcessScheduler.launch`` when it
+    was constructed with the job config."""
+    from tony_tpu import conf as conf_mod
+    if not conf.get_bool(conf_mod.DOCKER_ENABLED, False):
+        return argv
+    image = conf.get(conf_mod.DOCKER_IMAGE, "")
+    if not image:
+        raise ValueError("tony.docker.enabled=true requires "
+                         "tony.docker.containers.image")
+    return ["docker", "run", "--rm", "--network=host",
+            image] + argv
+
+
+class TpuVmScheduler(ContainerScheduler):
+    """Multi-host pod-slice backend: one executor per TPU-VM worker via SSH.
+
+    Interface-complete but deliberately thin: this environment has a single
+    chip and no pod, so remote launches cannot be exercised here. The
+    contract mirrors ``gcloud compute tpus tpu-vm ssh --worker=N --command``
+    fan-out: ``hosts`` lists worker addresses; each launch is pinned
+    round-robin (task global order) to a host, and the executor env rides the
+    SSH command line. Completion is detected by the remote shell exiting.
+    """
+
+    def __init__(self, hosts: List[str], ssh_cmd: str = "ssh",
+                 remote_python: str = "python3",
+                 remote_workdir: str = "/tmp/tony-tpu"):
+        if not hosts:
+            raise ValueError("TpuVmScheduler requires at least one host")
+        self.hosts = list(hosts)
+        self.ssh_cmd = ssh_cmd
+        self.remote_python = remote_python
+        self.remote_workdir = remote_workdir
+        self._running: Dict[str, Container] = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def build_remote_command(self, launch: ContainerLaunch,
+                             host: str) -> List[str]:
+        """The SSH argv for one executor launch (separated for testability:
+        command construction is covered by unit tests, the network is not)."""
+        exports = " ".join(
+            f"export {k}={shlex.quote(v)};" for k, v in
+            sorted({**launch.env, "TONY_EXECUTOR_HOST": host}.items()))
+        remote = (f"mkdir -p {self.remote_workdir} && cd {self.remote_workdir} "
+                  f"&& {exports} {self.remote_python} -m tony_tpu.executor")
+        return [self.ssh_cmd, host, remote]
+
+    def _host_for(self, launch: ContainerLaunch) -> str:
+        with self._lock:
+            host = self.hosts[self._next_id % len(self.hosts)]
+        return host
+
+    def launch(self, launch: ContainerLaunch) -> Container:
+        host = self._host_for(launch)
+        with self._lock:
+            self._next_id += 1
+            cid = f"container_tpuvm_{self._next_id:04d}"
+        proc = subprocess.Popen(
+            self.build_remote_command(launch, host),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        c = Container(container_id=cid, job_type=launch.job_type,
+                      index=launch.index, host=host, _proc=proc)
+        with self._lock:
+            self._running[cid] = c
+        return c
+
+    def poll_completed(self) -> List[Container]:
+        done = []
+        with self._lock:
+            for cid, c in list(self._running.items()):
+                rc = c._proc.poll() if c._proc else -1
+                if rc is not None:
+                    c.exit_code = (constants.EXIT_PREEMPTED if c.preempted
+                                   else rc)
+                    del self._running[cid]
+                    done.append(c)
+        return done
+
+    def stop_container(self, container: Container) -> None:
+        with self._lock:
+            c = self._running.get(container.container_id)
+        if c is not None and c._proc is not None and c._proc.poll() is None:
+            c._proc.terminate()
+
+    def preempt(self, container_id: str) -> bool:
+        with self._lock:
+            c = self._running.get(container_id)
+        if c is None or c._proc is None or c._proc.poll() is not None:
+            return False
+        c.preempted = True
+        c._proc.kill()
+        return True
+
+    def stop(self) -> None:
+        for c in list(self._running.values()):
+            self.stop_container(c)
