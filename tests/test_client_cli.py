@@ -1,0 +1,211 @@
+"""Client + CLI + history + proxy tests (reference tiers: ``TonyClient`` unit
++ e2e paths of ``TestTonyE2E``, the tony-cli surface, and the history-server
+parser/controller tests — SURVEY.md §4)."""
+
+import io
+import json
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants
+from tony_tpu.cli import main as cli_main
+from tony_tpu.client import TonyClient
+from tony_tpu.conf import TonyConfig
+from tony_tpu.history import (HistoryServer, find_job, gather_jobs,
+                              job_detail, render_list, render_show)
+from tony_tpu.proxy import ProxyServer
+
+WORKLOADS = Path(__file__).parent / "workloads"
+
+
+def base_props(**over):
+    props = {
+        "tony.application.framework": "standalone",
+        "tony.application.executes": "python exit_0.py",
+        "tony.worker.instances": "1",
+        "tony.task.heartbeat-interval-ms": "200",
+    }
+    props.update({k: str(v) for k, v in over.items()})
+    return props
+
+
+def run_client(tmp_path, stream=None, **over) -> TonyClient:
+    client = TonyClient(TonyConfig(base_props(**over)), src_dir=WORKLOADS,
+                        workdir=tmp_path / "jobs", stream=stream or io.StringIO())
+    client.exit_code = client.run(timeout=90)
+    return client
+
+
+def test_client_submit_monitor_success(tmp_path):
+    out = io.StringIO()
+    client = run_client(tmp_path, stream=out)
+    assert client.exit_code == 0
+    assert client.final_status == "SUCCEEDED"
+    text = out.getvalue()
+    # The reference's monitor loop prints task transitions.
+    assert "task worker:0 -> RUNNING" in text
+    assert "task worker:0 -> SUCCEEDED" in text
+    assert "finished: SUCCEEDED" in text
+
+
+def test_client_failure_exit_code_contract(tmp_path):
+    client = run_client(tmp_path, **{
+        "tony.application.executes": "python exit_1.py"})
+    assert client.exit_code == 1
+    assert client.final_status == "FAILED"
+
+
+def test_client_listener_sees_task_infos(tmp_path):
+    seen = []
+    client = TonyClient(TonyConfig(base_props()), src_dir=WORKLOADS,
+                        workdir=tmp_path / "jobs", stream=io.StringIO())
+    client.add_listener(lambda infos: seen.append(
+        {i["job_type"] + ":" + str(i["index"]): i["status"] for i in infos}))
+    assert client.run(timeout=90) == 0
+    assert seen, "listener never invoked"
+    assert any("worker:0" in snap for snap in seen)
+
+
+def test_cli_submit_end_to_end(tmp_path, capsys):
+    rc = cli_main([
+        "submit", "--src_dir", str(WORKLOADS),
+        "--executes", "python exit_0.py",
+        "--framework", "standalone",
+        "--workdir", str(tmp_path / "jobs"),
+        "--conf", "tony.worker.instances=1",
+        "--conf", "tony.task.heartbeat-interval-ms=200",
+    ])
+    assert rc == 0
+
+
+def test_cli_conf_file_xml_layering(tmp_path):
+    xml = tmp_path / "tony.xml"
+    xml.write_text("""<configuration>
+      <property><name>tony.worker.instances</name><value>1</value></property>
+      <property><name>tony.application.framework</name><value>standalone</value></property>
+      <property><name>tony.application.executes</name><value>python exit_1.py</value></property>
+    </configuration>""")
+    # --conf override beats the conf_file value (layering contract).
+    rc = cli_main([
+        "submit", "--src_dir", str(WORKLOADS), "--conf_file", str(xml),
+        "--workdir", str(tmp_path / "jobs"),
+        "--conf", "tony.application.executes=python exit_0.py",
+        "--conf", "tony.task.heartbeat-interval-ms=200",
+    ])
+    assert rc == 0
+
+
+def test_cli_version(capsys):
+    assert cli_main(["version"]) == 0
+    assert "tony-tpu" in capsys.readouterr().out
+
+
+def test_cli_rejects_bad_conf_pair():
+    with pytest.raises(SystemExit):
+        cli_main(["submit", "--conf", "not-a-pair"])
+
+
+def test_am_sigterm_graceful_teardown(tmp_path):
+    """SIGTERM to the AM process (client kill fallback) must drain through
+    normal teardown: containers reaped, final-status.json written KILLED."""
+    import time
+    client = TonyClient(
+        TonyConfig(base_props(**{
+            "tony.application.executes": "python forever.py"})),
+        src_dir=WORKLOADS, workdir=tmp_path / "jobs", stream=io.StringIO())
+    client.submit()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            addr = client._am_address()
+            if addr is not None:
+                from tony_tpu.rpc import RpcClient
+                try:
+                    with RpcClient(addr, timeout=2.0) as c:
+                        infos = c.call("get_task_infos")
+                    if any(i["status"] == "RUNNING" for i in infos):
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.1)
+        client.am_proc.terminate()          # SIGTERM, not SIGKILL
+        rc = client.monitor(timeout=60)
+        assert rc == 1
+        assert client.final_status == "KILLED"
+        assert "SIGTERM" in client.final_message
+        # No orphaned executor/user processes: every container workdir's
+        # processes died with the job (scheduler.stop ran in AM teardown).
+        final = json.loads((client.job_dir / "final-status.json").read_text())
+        assert final["status"] == "KILLED"
+    finally:
+        if client.am_proc.poll() is None:
+            client.am_proc.kill()
+
+
+# -- history ---------------------------------------------------------------
+
+def test_history_list_show_and_portal(tmp_path):
+    client = run_client(tmp_path)
+    history_dir = client.job_dir / "history"
+    jobs = gather_jobs(history_dir)
+    assert len(jobs) == 1
+    assert jobs[0]["app_id"] == client.app_id
+    assert jobs[0]["state"] == "finished"
+    listing = render_list(jobs)
+    assert client.app_id in listing
+
+    job = find_job(client.app_id, history_dir)
+    detail = job_detail(job)
+    assert detail["final"]["status"] == "SUCCEEDED"
+    assert any(t["job_type"] == "worker" for t in detail["tasks"])
+    shown = render_show(detail)
+    assert "SUCCEEDED" in shown and "worker:0" in shown
+
+    server = HistoryServer(history_dir, host="127.0.0.1", port=0)
+    import threading
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        index = urllib.request.urlopen(f"{base}/", timeout=10).read().decode()
+        assert client.app_id in index
+        page = urllib.request.urlopen(
+            f"{base}/jobs/{client.app_id}", timeout=10).read().decode()
+        assert "SUCCEEDED" in page and "worker:0" in page
+        api = json.loads(urllib.request.urlopen(
+            f"{base}/api/jobs", timeout=10).read())
+        assert api[0]["app_id"] == client.app_id
+        assert urllib.request.urlopen(
+            f"{base}/jobs/nope", timeout=10).status  # pragma: no cover
+    except urllib.error.HTTPError as e:
+        assert e.code == 404  # the /jobs/nope probe
+    finally:
+        server.shutdown()
+
+
+# -- proxy -----------------------------------------------------------------
+
+def test_proxy_roundtrip():
+    import socket
+    import threading
+
+    # Upstream echo server.
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    upstream_port = srv.getsockname()[1]
+
+    def echo_once():
+        conn, _ = srv.accept()
+        data = conn.recv(1024)
+        conn.sendall(b"echo:" + data)
+        conn.close()
+
+    threading.Thread(target=echo_once, daemon=True).start()
+    with ProxyServer("127.0.0.1", upstream_port) as proxy:
+        c = socket.create_connection(("127.0.0.1", proxy.local_port), timeout=5)
+        c.sendall(b"hello")
+        assert c.recv(1024) == b"echo:hello"
+        c.close()
+    srv.close()

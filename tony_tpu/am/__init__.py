@@ -80,6 +80,17 @@ class ApplicationMaster:
         if not self.quiet:
             print(f"[tony-am {self.app_id}] {msg}", file=sys.stderr, flush=True)
 
+    def request_stop(self, reason: str) -> None:
+        """Graceful external stop (SIGTERM from the client's kill fallback):
+        mark the job KILLED so the monitor loop exits through its normal
+        teardown — containers reaped, events finalized, final status written."""
+        session = self.session
+        if session is not None:
+            with session.lock:
+                if session.job_status == JobStatus.RUNNING:
+                    session.job_status = JobStatus.KILLED
+                    session.final_message = reason
+
     # -- container plumbing ------------------------------------------------
     def _launch_task(self, session: TonySession, job_type: str,
                      index: int) -> None:
@@ -126,8 +137,17 @@ class ApplicationMaster:
         max_missed = self.conf.get_int(conf_mod.TASK_MAX_MISSED_HEARTBEATS, 25)
         expiry = interval_s * max_missed
         now = time.monotonic()
+        # Before the gang barrier, non-registration is the gang timeout's
+        # job; after it, a relaunched (preempted) executor that freezes
+        # before registering has no other watchdog, so ALLOCATED tasks are
+        # covered too (touch() at launch seeds their clock).
+        barrier_passed = (self.handler is not None
+                          and self.handler._all_registered_fired)
+        watched = (TaskStatus.REGISTERED, TaskStatus.RUNNING) if not \
+            barrier_passed else (TaskStatus.ALLOCATED, TaskStatus.REGISTERED,
+                                 TaskStatus.RUNNING)
         for task in session.tasks():
-            if task.status in (TaskStatus.REGISTERED, TaskStatus.RUNNING) \
+            if task.status in watched \
                     and task.last_heartbeat \
                     and now - task.last_heartbeat > expiry:
                 self._log(f"task {task.task_id} missed {max_missed} "
@@ -298,6 +318,10 @@ class ApplicationMaster:
                     "status": status.value,
                     "message": self.final_message,
                     "app_id": self.app_id,
+                    # Terminal task snapshot so the client can report final
+                    # transitions even after the RPC server is gone.
+                    "task_infos": (self.session.task_infos()
+                                   if self.session else []),
                 }))
             self.scheduler.stop()
             if self.server is not None:

@@ -3,6 +3,7 @@
 client's behalf — SURVEY.md §3.1)."""
 
 import argparse
+import signal
 import sys
 
 from tony_tpu.am import ApplicationMaster
@@ -21,6 +22,10 @@ def main(argv=None) -> int:
     conf = TonyConfig.load(args.conf)
     am = ApplicationMaster(conf, app_id=args.app_id, job_dir=args.job_dir,
                            host=args.host, quiet=not args.verbose)
+    # Graceful SIGTERM (client kill fallback): drain through the AM's normal
+    # teardown instead of dying mid-loop and orphaning executor groups.
+    signal.signal(signal.SIGTERM,
+                  lambda _sig, _frm: am.request_stop("AM received SIGTERM"))
     return am.run()
 
 

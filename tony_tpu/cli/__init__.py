@@ -1,0 +1,139 @@
+"""``tony`` command-line interface (layer L6).
+
+Mirrors ``tony-cli``'s ``ClusterSubmitter`` (upstream ``tony-cli/src/main/
+java/com/linkedin/tony/cli/ClusterSubmitter.java``, unverified — SURVEY.md
+§0/§2.2) plus the client flag surface of ``TonyClient#init``. The flags keep
+the reference's names so existing TonY job definitions translate directly::
+
+    tony submit --src_dir src/ --executes train.py --conf_file tony.xml \
+                --conf tony.worker.instances=2 --framework jax
+
+Subcommands:
+
+* ``submit``  — submit a job and monitor it to completion (exit code = job's)
+* ``history`` — list finished/running jobs, or show one job's events
+* ``notebook``— single-container notebook session behind the TCP proxy
+  (reference: ``NotebookSubmitter``)
+* ``version`` — print the framework version
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+from tony_tpu import __version__
+from tony_tpu import conf as conf_mod
+from tony_tpu.conf import TonyConfig
+
+
+def _parse_conf_overrides(pairs: List[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--conf expects key=value, got {pair!r}")
+        k, _, v = pair.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def build_conf(args: argparse.Namespace) -> TonyConfig:
+    """Effective config from file + CLI switches + ``--conf`` overrides —
+    the reference's layering (SURVEY.md §5.6), highest precedence last."""
+    cfg = TonyConfig()
+    if args.conf_file:
+        cfg.merge_file(args.conf_file)
+    if getattr(args, "executes", None):
+        cfg.set("tony.application.executes", args.executes)
+    if getattr(args, "framework", None):
+        cfg.set(conf_mod.APPLICATION_FRAMEWORK, args.framework)
+    if getattr(args, "name", None):
+        cfg.set(conf_mod.APPLICATION_NAME, args.name)
+    if getattr(args, "python_venv", None):
+        cfg.set("tony.application.python-venv", args.python_venv)
+    if getattr(args, "python_binary_path", None):
+        cfg.set("tony.application.python-binary", args.python_binary_path)
+    cfg.merge_overrides(_parse_conf_overrides(args.conf or []))
+    return cfg
+
+
+def cmd_submit(args: argparse.Namespace) -> int:
+    from tony_tpu.client import TonyClient
+    cfg = build_conf(args)
+    client = TonyClient(cfg, src_dir=args.src_dir, workdir=args.workdir,
+                        am_host=args.am_host, quiet=args.quiet)
+    return client.run(timeout=args.timeout)
+
+
+def cmd_history(args: argparse.Namespace) -> int:
+    from tony_tpu.history import main as history_main
+    return history_main(args)
+
+
+def cmd_notebook(args: argparse.Namespace) -> int:
+    from tony_tpu.notebook import main as notebook_main
+    return notebook_main(args)
+
+
+def cmd_version(_args: argparse.Namespace) -> int:
+    print(f"tony-tpu {__version__}")
+    return 0
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tony", description="TonY-TPU: TPU-native distributed-job orchestrator")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("submit", help="submit a job and monitor to completion")
+    s.add_argument("--src_dir", help="user source directory to stage")
+    s.add_argument("--executes", help="command to run in each task container")
+    s.add_argument("--conf_file", help="tony.xml / JSON job config")
+    s.add_argument("--conf", action="append", metavar="KEY=VALUE",
+                   help="config override (repeatable)")
+    s.add_argument("--framework", help="jax|tensorflow|pytorch|horovod|mxnet|standalone")
+    s.add_argument("--name", help="application name")
+    s.add_argument("--python_venv", help="virtualenv archive/dir to ship")
+    s.add_argument("--python_binary_path", help="python interpreter inside the venv")
+    s.add_argument("--workdir", help="client work dir (default ~/.tony-tpu/jobs)")
+    s.add_argument("--am_host", default="127.0.0.1",
+                   help="address executors use to reach the AM")
+    s.add_argument("--timeout", type=float, default=None,
+                   help="client-side monitor timeout in seconds")
+    s.add_argument("--quiet", action="store_true")
+    s.set_defaults(fn=cmd_submit)
+
+    h = sub.add_parser("history", help="list jobs or show one job's events")
+    h.add_argument("action", choices=["list", "show", "serve"],
+                   help="list all jobs / show one job / serve the web portal")
+    h.add_argument("app_id", nargs="?", help="application id (for show)")
+    h.add_argument("--history", dest="history_dir",
+                   help="history root dir (default: scan client workdir)")
+    h.add_argument("--port", type=int, default=19885,
+                   help="portal port (for serve)")
+    h.set_defaults(fn=cmd_history)
+
+    n = sub.add_parser("notebook", help="run a notebook/command in one "
+                       "container behind a TCP proxy")
+    n.add_argument("--src_dir", help="source directory to stage")
+    n.add_argument("--executes", required=True,
+                   help="notebook/server command; it should bind $TB_PORT")
+    n.add_argument("--conf_file", help="tony.xml / JSON job config")
+    n.add_argument("--conf", action="append", metavar="KEY=VALUE")
+    n.add_argument("--workdir", help="client work dir")
+    n.add_argument("--port", type=int, default=0,
+                   help="local proxy port (0 = ephemeral)")
+    n.set_defaults(fn=cmd_notebook)
+
+    v = sub.add_parser("version", help="print version")
+    v.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into a pager/head that exited; not an error.
+        return 0

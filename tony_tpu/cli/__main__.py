@@ -1,0 +1,9 @@
+"""``python -m tony_tpu.cli`` — the ``tony`` entry point (reference:
+``ClusterSubmitter.main`` via the ``tony-cli`` shadow jar)."""
+
+import sys
+
+from tony_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

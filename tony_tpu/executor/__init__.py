@@ -234,7 +234,8 @@ class TaskExecutor:
             stdout.close()
             stderr.close()
             if tb_port is not None and self.job_type in (
-                    constants.TENSORBOARD, *constants.CHIEF_LIKE_JOB_TYPES):
+                    constants.TENSORBOARD, constants.NOTEBOOK,
+                    *constants.CHIEF_LIKE_JOB_TYPES):
                 try:
                     self.client.call("register_tensorboard_url",
                                      url=f"http://{self.host}:{tb_port}")

@@ -1,0 +1,266 @@
+"""History server: the observability portal over jhist event logs (layer L⊥).
+
+Mirrors ``tony-history-server`` (upstream Play-framework app ≈3,000 LoC,
+unverified — SURVEY.md §0/§2.2/§3.5): scan the history root's
+``finished/``+``intermediate/`` dirs, parse each job's jhist, and render a job
+list plus per-job config/events/metrics pages. The reference renders Twirl
+templates behind Play; here the same read path (:func:`tony_tpu.events
+.list_jobs` / :func:`~tony_tpu.events.read_events`) feeds either a terminal
+renderer (``tony history list|show``) or a stdlib ``http.server`` portal
+(``tony history serve``) — no web framework dependency.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from tony_tpu import events as ev
+
+
+def default_history_dir() -> Optional[Path]:
+    """The client workdir's per-job history dirs don't share one root; the
+    conventional root is ``~/.tony-tpu/history`` (set
+    ``tony.history.location`` to use it). Fall back to scanning the client
+    workdir for per-job ``history/`` subdirs."""
+    root = Path.home() / ".tony-tpu" / "history"
+    return root if root.is_dir() else None
+
+
+def gather_jobs(history_dir: Optional[str | Path]) -> List[Dict[str, Any]]:
+    """All jobs under a history root, or — when no single root exists — under
+    every ``<workdir>/<app_id>/history`` the client has written."""
+    if history_dir is not None:
+        return list(ev.list_jobs(history_dir))
+    jobs: List[Dict[str, Any]] = []
+    root = default_history_dir()
+    if root is not None:
+        jobs.extend(ev.list_jobs(root))
+    workdir = Path.home() / ".tony-tpu" / "jobs"
+    if workdir.is_dir():
+        for jobdir in sorted(workdir.iterdir()):
+            h = jobdir / "history"
+            if h.is_dir():
+                jobs.extend(ev.list_jobs(h))
+    return jobs
+
+
+def find_job(app_id: str,
+             history_dir: Optional[str | Path]) -> Optional[Dict[str, Any]]:
+    for job in gather_jobs(history_dir):
+        if job["app_id"] == app_id:
+            return job
+    return None
+
+
+def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
+    """Parsed view of one job: metadata, final status, per-task rows, events
+    (reference: JobDetailPageController's model assembly)."""
+    records = ev.read_events(job["path"])
+    meta = job.get("metadata") or {}
+    final = next((r["payload"] for r in records
+                  if r["type"] == ev.APPLICATION_FINISHED), {})
+    tasks = [dict(r["payload"], timestamp=r["timestamp"])
+             for r in records if r["type"] == ev.TASK_FINISHED]
+    return {
+        "app_id": job["app_id"],
+        "state": job["state"],
+        "metadata": meta,
+        "final": final,
+        "tasks": tasks,
+        "events": records,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Terminal rendering (tony history list / show)
+# ---------------------------------------------------------------------------
+
+def render_list(jobs: List[Dict[str, Any]]) -> str:
+    if not jobs:
+        return "no jobs found"
+    lines = [f"{'APP ID':<28} {'STATE':<9} {'USER':<10} {'NAME':<24} STARTED"]
+    for job in jobs:
+        m = job.get("metadata") or {}
+        started = m.get("started")
+        when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(started))
+                if started else "-")
+        lines.append(f"{job['app_id']:<28} {job['state']:<9} "
+                     f"{m.get('user', '-'):<10} {m.get('app_name', '-'):<24} "
+                     f"{when}")
+    return "\n".join(lines)
+
+
+def render_show(detail: Dict[str, Any]) -> str:
+    out = [f"application {detail['app_id']} [{detail['state']}]"]
+    final = detail["final"]
+    if final:
+        out.append(f"  status: {final.get('status')}"
+                   + (f" — {final['message']}" if final.get("message") else ""))
+    m = detail["metadata"]
+    if m:
+        out.append(f"  user: {m.get('user')}  name: {m.get('app_name')}")
+    if detail["tasks"]:
+        out.append("  tasks:")
+        for t in detail["tasks"]:
+            metrics = t.get("metrics") or {}
+            mstr = (" " + " ".join(f"{k}={v}" for k, v in sorted(
+                metrics.items()))) if metrics else ""
+            out.append(f"    {t['job_type']}:{t['index']} {t['status']} "
+                       f"exit={t.get('exit_code')}{mstr}"
+                       + (f" — {t['diagnostics']}" if t.get("diagnostics") else ""))
+    out.append("  events:")
+    for r in detail["events"]:
+        when = time.strftime("%H:%M:%S", time.localtime(r["timestamp"]))
+        out.append(f"    {when} {r['type']}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# HTTP portal (tony history serve) — reference: the Play web app
+# ---------------------------------------------------------------------------
+
+_PAGE = """<!doctype html><html><head><title>{title}</title><style>
+body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
+th{{background:#f0f0f0}}a{{text-decoration:none}}
+.ok{{color:#070}}.bad{{color:#b00}}</style></head>
+<body><h2>{title}</h2>{body}</body></html>"""
+
+
+def _jobs_page(jobs: List[Dict[str, Any]]) -> str:
+    rows = []
+    for job in jobs:
+        m = job.get("metadata") or {}
+        started = m.get("started")
+        when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(started))
+                if started else "-")
+        rows.append(
+            f"<tr><td><a href='/jobs/{html.escape(job['app_id'])}'>"
+            f"{html.escape(job['app_id'])}</a></td>"
+            f"<td>{html.escape(job['state'])}</td>"
+            f"<td>{html.escape(str(m.get('user', '-')))}</td>"
+            f"<td>{html.escape(str(m.get('app_name', '-')))}</td>"
+            f"<td>{when}</td></tr>")
+    body = ("<table><tr><th>app id</th><th>state</th><th>user</th>"
+            "<th>name</th><th>started</th></tr>" + "".join(rows) + "</table>")
+    return _PAGE.format(title="TonY-TPU jobs", body=body)
+
+
+def _job_page(detail: Dict[str, Any]) -> str:
+    final = detail["final"]
+    status = final.get("status", detail["state"])
+    cls = "ok" if status == "SUCCEEDED" else "bad"
+    parts = [f"<p>status: <b class='{cls}'>{html.escape(str(status))}</b>"]
+    if final.get("message"):
+        parts.append(f" — {html.escape(final['message'])}")
+    parts.append("</p><h3>Tasks</h3><table><tr><th>task</th><th>status</th>"
+                 "<th>exit</th><th>metrics</th><th>diagnostics</th></tr>")
+    for t in detail["tasks"]:
+        metrics = ", ".join(f"{k}={v}" for k, v in sorted(
+            (t.get("metrics") or {}).items()))
+        parts.append(
+            f"<tr><td>{html.escape(t['job_type'])}:{t['index']}</td>"
+            f"<td>{html.escape(t['status'])}</td>"
+            f"<td>{t.get('exit_code')}</td><td>{html.escape(metrics)}</td>"
+            f"<td>{html.escape(t.get('diagnostics') or '')}</td></tr>")
+    parts.append("</table><h3>Events</h3><table><tr><th>time</th>"
+                 "<th>type</th><th>payload</th></tr>")
+    for r in detail["events"]:
+        when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(r["timestamp"]))
+        payload = html.escape(json.dumps(r["payload"], sort_keys=True)[:400])
+        parts.append(f"<tr><td>{when}</td><td>{html.escape(r['type'])}</td>"
+                     f"<td><code>{payload}</code></td></tr>")
+    parts.append("</table><h3>Config</h3><table><tr><th>key</th><th>value</th></tr>")
+    for k, v in sorted((detail["metadata"].get("config") or {}).items()):
+        parts.append(f"<tr><td>{html.escape(k)}</td>"
+                     f"<td>{html.escape(str(v))}</td></tr>")
+    parts.append("</table><p><a href='/'>← all jobs</a></p>")
+    return _PAGE.format(title=f"Job {html.escape(detail['app_id'])}",
+                        body="".join(parts))
+
+
+class HistoryServer:
+    """Tiny threaded HTTP portal over a history root."""
+
+    def __init__(self, history_dir: Optional[str | Path],
+                 host: str = "0.0.0.0", port: int = 19885):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/html; charset=utf-8") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                try:
+                    if self.path in ("/", "/jobs"):
+                        self._send(200, _jobs_page(gather_jobs(outer.history_dir)))
+                    elif self.path.startswith("/jobs/"):
+                        app_id = self.path[len("/jobs/"):]
+                        job = find_job(app_id, outer.history_dir)
+                        if job is None:
+                            self._send(404, _PAGE.format(
+                                title="Not found",
+                                body=f"<p>no job {html.escape(app_id)}</p>"))
+                        else:
+                            self._send(200, _job_page(job_detail(job)))
+                    elif self.path == "/api/jobs":
+                        self._send(200, json.dumps(
+                            gather_jobs(outer.history_dir), default=str),
+                            "application/json")
+                    else:
+                        self._send(404, _PAGE.format(
+                            title="Not found", body="<p>404</p>"))
+                except BrokenPipeError:
+                    pass
+
+        self.history_dir = Path(history_dir) if history_dir else None
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(args) -> int:
+    """CLI entry (``tony history ...``)."""
+    history_dir = getattr(args, "history_dir", None)
+    if args.action == "list":
+        print(render_list(gather_jobs(history_dir)))
+        return 0
+    if args.action == "show":
+        if not args.app_id:
+            print("usage: tony history show <app_id>")
+            return 2
+        job = find_job(args.app_id, history_dir)
+        if job is None:
+            print(f"no job {args.app_id} found")
+            return 1
+        print(render_show(job_detail(job)))
+        return 0
+    if args.action == "serve":
+        server = HistoryServer(history_dir, port=args.port)
+        print(f"history portal at http://127.0.0.1:{server.port}/")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+    return 2

@@ -1,0 +1,50 @@
+"""Notebook submitter: one interactive container behind the TCP proxy.
+
+Mirrors ``tony-cli``'s ``NotebookSubmitter`` (upstream ``tony-cli/src/main/
+java/com/linkedin/tony/cli/NotebookSubmitter.java``, unverified — SURVEY.md
+§0/§2.2): submit a single ``notebook`` task on the StandaloneRuntime, wait
+for the task to come up and register its URL (the executor reserves the
+``TB_PORT`` sidecar port and reports it via ``register_tensorboard_url``),
+then run a local :class:`~tony_tpu.proxy.ProxyServer` so the gateway user can
+reach it. The notebook command should bind ``$TB_PORT``.
+"""
+
+from __future__ import annotations
+
+from tony_tpu import conf as conf_mod
+from tony_tpu.cli import _parse_conf_overrides
+from tony_tpu.client import TonyClient
+from tony_tpu.conf import TonyConfig
+from tony_tpu.proxy import ProxyServer
+
+
+def main(args) -> int:
+    cfg = TonyConfig()
+    if getattr(args, "conf_file", None):
+        cfg.merge_file(args.conf_file)
+    cfg.set(conf_mod.APPLICATION_FRAMEWORK, "standalone")
+    cfg.set("tony.notebook.instances", "1")
+    cfg.set("tony.notebook.command", args.executes)
+    cfg.merge_overrides(_parse_conf_overrides(args.conf or []))
+    client = TonyClient(cfg, src_dir=args.src_dir, workdir=args.workdir)
+    proxy_holder: dict = {}
+
+    def on_update(infos) -> None:
+        if proxy_holder or client.tensorboard_url is None:
+            return
+        url = client.tensorboard_url  # http://host:port
+        hostport = url.split("//", 1)[-1]
+        host, _, port = hostport.rpartition(":")
+        proxy = ProxyServer(host or "127.0.0.1", int(port),
+                            local_port=args.port).start()
+        proxy_holder["proxy"] = proxy
+        print(f"notebook reachable at http://127.0.0.1:{proxy.local_port}/ "
+              f"(proxied to {hostport})", flush=True)
+
+    client.add_listener(on_update)
+    try:
+        return client.run()
+    finally:
+        proxy = proxy_holder.get("proxy")
+        if proxy is not None:
+            proxy.stop()

@@ -1,0 +1,95 @@
+"""TCP proxy: gateway access to in-cluster notebook/TensorBoard ports.
+
+Mirrors ``tony-proxy``'s ``ProxyServer`` (upstream ``tony-proxy/src/main/
+java/``, ≈200 LoC, unverified — SURVEY.md §0/§2.2): a dumb bidirectional TCP
+port-forwarder so a user on the gateway host can reach a port that only
+exists inside the cluster network (the notebook container, a TensorBoard).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+_BUF = 65536
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    """Relay src→dst until EOF, then propagate the FIN with a half-close of
+    dst's write side only — the other direction may still be mid-response
+    (TCP half-close semantics; a full SHUT_RDWR here would truncate it)."""
+    try:
+        while True:
+            data = src.recv(_BUF)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        try:
+            dst.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+
+def _relay(client: socket.socket, upstream: socket.socket) -> None:
+    """Run both pump directions; close the sockets only when both are done."""
+    t = threading.Thread(target=_pump, args=(upstream, client), daemon=True)
+    t.start()
+    _pump(client, upstream)
+    t.join()
+    for s in (client, upstream):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+class ProxyServer:
+    """Forward ``localhost:local_port`` → ``remote_host:remote_port``."""
+
+    def __init__(self, remote_host: str, remote_port: int,
+                 local_host: str = "127.0.0.1", local_port: int = 0):
+        self.remote = (remote_host, int(remote_port))
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((local_host, local_port))
+        self._listener.listen(16)
+        self.local_host, self.local_port = self._listener.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="tony-proxy", daemon=True)
+
+    def start(self) -> "ProxyServer":
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self.remote, timeout=10)
+            except OSError:
+                client.close()
+                continue
+            threading.Thread(target=_relay, args=(client, upstream),
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+    def __enter__(self) -> "ProxyServer":
+        return self.start()
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()

@@ -125,9 +125,10 @@ class TaskExecutorAdapter:
     """Executor-side SPI (reference: ``Framework.TaskExecutorAdapter``)."""
 
     def need_reserve_tb_port(self, ctx: TaskContext) -> bool:
-        """Whether this task should reserve a TensorBoard port (chief or a
-        dedicated ``tensorboard`` task)."""
-        return ctx.job_type in (constants.TENSORBOARD,) or (
+        """Whether this task should reserve a sidecar HTTP port: a dedicated
+        ``tensorboard`` or ``notebook`` task, or the chief when no dedicated
+        tensorboard task exists."""
+        return ctx.job_type in (constants.TENSORBOARD, constants.NOTEBOOK) or (
             ctx.job_type in constants.CHIEF_LIKE_JOB_TYPES and
             constants.TENSORBOARD not in ctx.job_types())
 

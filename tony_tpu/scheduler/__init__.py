@@ -35,6 +35,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from tony_tpu import constants
+from tony_tpu.util import child_pythonpath
 
 
 @dataclass
@@ -134,12 +135,7 @@ class LocalProcessScheduler(ContainerScheduler):
         env[constants.ENV_CONTAINER_ID] = cid
         env.setdefault(constants.ENV_LOG_DIR, str(workdir))
         env["TONY_EXECUTOR_HOST"] = self.host
-        # The executor subprocess must find tony_tpu even when the parent
-        # imported it off sys.path (tests) rather than an installed package.
-        pkg_root = str(Path(__file__).resolve().parent.parent.parent)
-        parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(
-            os.pathsep) if p and p != pkg_root]
-        env["PYTHONPATH"] = os.pathsep.join(parts)
+        env["PYTHONPATH"] = child_pythonpath(env)
         argv = [sys.executable, "-m", "tony_tpu.executor"]
         if self.conf is not None:
             argv = docker_wrap_command(self.conf, argv)

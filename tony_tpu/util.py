@@ -1,0 +1,20 @@
+"""Small shared helpers (reference: the ``com.linkedin.tony.util.Utils``
+grab-bag, kept deliberately tiny here — SURVEY.md §2.1)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict
+
+# The repo/package root: parent of the tony_tpu package directory.
+PKG_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+def child_pythonpath(env: Dict[str, str]) -> str:
+    """PYTHONPATH for a child process that must import ``tony_tpu`` even when
+    the parent loaded it off ``sys.path`` (tests / source checkout) rather
+    than an installed package: prepend the package root, dedupe."""
+    parts = [PKG_ROOT] + [p for p in env.get("PYTHONPATH", "").split(
+        os.pathsep) if p and p != PKG_ROOT]
+    return os.pathsep.join(parts)
