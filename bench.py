@@ -1,0 +1,111 @@
+"""Benchmark: ResNet-50 data-parallel train step on the real TPU chip.
+
+North star (BASELINE.md): ≥55% MFU, images/sec/chip primary. This bench
+runs the full training step (forward + backward + SGD update + BatchNorm
+stats) on synthetic ImageNet-shaped data in bf16 and prints ONE JSON line::
+
+    {"metric": "resnet50_mfu", "value": ..., "unit": ..., "vs_baseline": ...}
+
+``vs_baseline`` is MFU / 0.55 (≥1.0 beats the target). Peak-FLOPs table per
+chip generation; generation from PALLAS_AXON_TPU_GEN / TPU_ACCELERATOR_TYPE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Peak bf16 matmul FLOP/s per chip by generation (public spec sheets).
+PEAK_BF16 = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def chip_generation() -> str:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN") or os.environ.get(
+        "TPU_ACCELERATOR_TYPE", "v5e")
+    return gen.split("-")[0].lower()
+
+
+def main() -> int:
+    import optax
+    import flax.linen as nn
+
+    from tony_tpu.models import get_model
+    from tony_tpu.models.resnet import resnet50_flops
+    from tony_tpu import train as tr
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "8"))
+    image = int(os.environ.get("BENCH_IMAGE", "224" if on_tpu else "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    model = get_model("resnet50")
+    kx, ky, kinit = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
+    y = jax.random.randint(ky, (batch,), 0, 1000)
+    variables = jax.jit(lambda: model.init(kinit, x, train=False))()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+
+    def step(params, opt_state, batch_stats, x, y):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return tr.cross_entropy_loss(logits, y), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_stats, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+    # Warmup: compile + one steady-state step.
+    for _ in range(2):
+        params, opt_state, batch_stats, loss = jstep(
+            params, opt_state, batch_stats, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, batch_stats, loss = jstep(
+            params, opt_state, batch_stats, x, y)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / elapsed
+    # fwd ≈ 8.2 GFLOP/image @224² (MACs×2); training ≈ 3× forward.
+    train_flops_per_step = 3 * resnet50_flops(batch, image)
+    gen = chip_generation()
+    peak = PEAK_BF16.get(gen, PEAK_BF16["v5e"]) if on_tpu else 1e12
+    mfu = train_flops_per_step * steps / elapsed / peak
+
+    print(json.dumps({
+        "metric": "resnet50_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_bf16_peak",
+        "vs_baseline": round(mfu / 0.55, 4),
+        "images_per_sec_per_chip": round(images_per_sec, 1),
+        "batch": batch,
+        "image": image,
+        "backend": backend,
+        "chip": gen,
+        "loss": float(loss),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

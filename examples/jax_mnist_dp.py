@@ -1,0 +1,90 @@
+"""Data-parallel MNIST on JAX — the canonical TonY-TPU job.
+
+Reference analogue: ``tony-examples/mnist-tensorflow`` /
+``mnist-distributed`` (SURVEY.md §2.2), re-designed for the JAXRuntime: the
+rendezvous is ``tony_tpu.distributed.initialize()`` (wired from the env the
+JAXRuntime adapter built), the data plane is the GSPMD gradient psum over
+the device mesh — no parameter server, no NCCL.
+
+Submit::
+
+    tony submit --framework jax --src_dir examples \\
+        --executes "python jax_mnist_dp.py" \\
+        --conf tony.worker.instances=2
+
+Uses synthetic MNIST-shaped data unless ``MNIST_NPZ`` points at the real
+arrays (keeps the example hermetic: the image has no dataset downloads).
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+
+import tony_tpu.distributed as dist
+
+dist.initialize()          # no-op single-process; rendezvous under TonY
+
+import jax.numpy as jnp
+import optax
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.checkpoint import Checkpointer
+from tony_tpu.models import get_model
+
+
+def load_data(rng, n=512):
+    npz = os.environ.get("MNIST_NPZ")
+    if npz and Path(npz).is_file():
+        import numpy as np
+        with np.load(npz) as d:
+            return (jnp.asarray(d["x_train"][:n]).reshape(n, -1) / 255.0,
+                    jnp.asarray(d["y_train"][:n]))
+    x = jax.random.normal(rng, (n, 784))
+    y = jax.random.randint(rng, (n,), 0, 10)
+    return x, y
+
+
+def main():
+    mesh = par.MeshSpec(dp=jax.device_count()).build()
+    model = get_model("mnist-mlp")
+    x, y = load_data(jax.random.PRNGKey(jax.process_index()))
+
+    state = train.create_train_state(
+        model, optax.adam(1e-3), jnp.zeros((1, 784)), jax.random.PRNGKey(0),
+        mesh=mesh)
+    # Checkpoint dir must be shared + stable across gang restarts (the
+    # per-container sandbox is replaced on restart); every process calls
+    # save/restore — orbax coordinates the actual writes.
+    ckpt_dir = os.environ.get("CKPT_DIR") or (
+        Path.home() / ".tony-tpu" / "ckpt"
+        / os.environ.get("TONY_APP_ID", "local-mnist"))
+    ckpt = Checkpointer(ckpt_dir)
+    state = ckpt.restore_or(state)
+    step_fn = train.make_train_step(mesh=mesh)
+
+    steps = int(os.environ.get("TRAIN_STEPS", "30"))
+    per = x.shape[0] // max(1, steps)
+    start = int(state.step)
+    loss = None
+    for i in range(start, steps):
+        lo = (i * per) % (x.shape[0] - per + 1)
+        batch = train.global_batch(mesh, {"x": x[lo:lo + per],
+                                          "y": y[lo:lo + per]})
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+        if i % 10 == 0:
+            if jax.process_index() == 0:
+                print(f"step {i}: loss {loss:.4f}", flush=True)
+            ckpt.save(state)
+    ckpt.save(state)
+    if jax.process_index() == 0:
+        Path("result.json").write_text(json.dumps({"final_loss": loss}))
+        print("done:", "already complete (resumed past TRAIN_STEPS)"
+              if loss is None else f"final loss {loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

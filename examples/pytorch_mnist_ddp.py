@@ -1,0 +1,58 @@
+"""PyTorch DDP MNIST over the PyTorchRuntime rendezvous.
+
+Reference analogue: ``tony-examples/mnist-pytorch`` (SURVEY.md §2.2). The
+PyTorchRuntime exports MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE/LOCAL_RANK;
+this script hands them to ``torch.distributed`` (gloo — CPU containers; on
+GPU clusters the reference used NCCL, which TonY-TPU does not ship: TPU
+training belongs to the JAXRuntime).
+
+Submit::
+
+    tony submit --framework pytorch --src_dir examples \\
+        --executes "python pytorch_mnist_ddp.py" \\
+        --conf tony.worker.instances=2
+"""
+
+import json
+import os
+from pathlib import Path
+
+import torch
+import torch.distributed as td
+import torch.nn as nn
+
+
+def main():
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    if world > 1:
+        td.init_process_group("gloo")
+    rank = td.get_rank() if world > 1 else 0
+
+    torch.manual_seed(rank)
+    model = nn.Sequential(nn.Linear(784, 128), nn.ReLU(), nn.Linear(128, 10))
+    if world > 1:
+        model = nn.parallel.DistributedDataParallel(model)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = nn.CrossEntropyLoss()
+
+    x = torch.randn(256, 784)
+    y = torch.randint(0, 10, (256,))
+    losses = []
+    for step in range(20):
+        opt.zero_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()        # DDP allreduces grads here
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    if rank == 0:
+        Path("result.json").write_text(json.dumps(
+            {"final_loss": losses[-1], "world_size": world}))
+        print(f"done: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+              f"(world={world})")
+    if world > 1:
+        td.destroy_process_group()
+
+
+if __name__ == "__main__":
+    main()

@@ -11,10 +11,20 @@ import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the session env pins JAX_PLATFORMS to the real TPU
+# plugin; tests must run on the virtual CPU mesh regardless. The site
+# customization imports jax at interpreter start, which latches JAX_PLATFORMS
+# into jax's config before this file runs — so update the config directly
+# too (safe: backends aren't initialized until first use).
+os.environ["JAX_PLATFORMS"] = "cpu"
 existing = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in existing:
     os.environ["XLA_FLAGS"] = (
         existing + " --xla_force_host_platform_device_count=8").strip()
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover — jax is baked into this image
+    pass
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

@@ -107,6 +107,26 @@ def test_cli_rejects_bad_conf_pair():
         cli_main(["submit", "--conf", "not-a-pair"])
 
 
+def test_venv_shipped_and_on_path(tmp_path):
+    """--python_venv stages the venv, executors localize it per container
+    and put its bin/ on PATH with VIRTUAL_ENV set."""
+    venv = tmp_path / "myvenv"
+    (venv / "bin").mkdir(parents=True)
+    marker = venv / "bin" / "tony-venv-marker"
+    marker.write_text("#!/bin/sh\n")
+    marker.chmod(0o755)
+    client = TonyClient(
+        TonyConfig(base_props(**{
+            "tony.application.executes": "python check_venv.py",
+            "tony.application.python-venv": str(venv)})),
+        src_dir=WORKLOADS, workdir=tmp_path / "jobs", stream=io.StringIO())
+    assert client.run(timeout=90) == 0
+    [check] = Path(client.job_dir).glob("containers/*/src/venv_check.json")
+    data = json.loads(check.read_text())
+    assert data["virtual_env"].endswith("venv")
+    assert "containers" in data["tool"]  # the per-container localized copy
+
+
 def test_am_sigterm_graceful_teardown(tmp_path):
     """SIGTERM to the AM process (client kill fallback) must drain through
     normal teardown: containers reaped, final-status.json written KILLED."""

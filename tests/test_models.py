@@ -1,0 +1,128 @@
+"""Model + train-harness tests on the 8-device CPU mesh: forward shapes,
+sharded init, one GSPMD train step per parallelism layout."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.models import get_model
+from tony_tpu.models.resnet import resnet50_flops
+
+
+def test_mnist_models_forward():
+    x = jnp.zeros((4, 28 * 28))
+    for name in ("mnist-mlp", "mnist-cnn"):
+        model = get_model(name)
+        params = model.init(jax.random.PRNGKey(0), x)
+        out = model.apply(params, x)
+        assert out.shape == (4, 10)
+
+
+def test_resnet_forward_and_bn_state():
+    model = get_model("resnet18-thin")
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert "batch_stats" in variables
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert resnet50_flops(32) > 1e11
+
+
+def test_llama_tiny_forward_loss_decreases():
+    model = get_model("llama-tiny")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    tx = optax.adam(1e-2)
+    state = train.create_train_state(
+        model, tx, tokens, jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]))
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, {"x": tokens})
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("spec_kw", [
+    dict(),                      # pure DP over 8 devices
+    dict(fsdp=2, tp=2),          # DP×FSDP×TP
+    dict(tp=4),                  # DP×TP
+])
+def test_llama_sharded_train_step(spec_kw):
+    mesh = par.make_mesh(**spec_kw)
+    model = get_model("llama-tiny")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 256)
+    tx = optax.adam(1e-3)
+    state = train.create_train_state(
+        model, tx, tokens, jax.random.PRNGKey(0), mesh=mesh)
+    # Params actually sharded per the rules: an ffn kernel splits over model.
+    if spec_kw.get("tp", 1) > 1:
+        ffn = state.params["layers"]["block"]["mlp"]["w_gate"]["kernel"]
+        assert "model" in jax.tree_util.tree_leaves(
+            [ffn.sharding.spec])[0] or any(
+            "model" == s or (isinstance(s, tuple) and "model" in s)
+            for s in ffn.sharding.spec if s)
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]),
+        mesh=mesh)
+    state, metrics = step(state, {"x": tokens})
+    assert np.isfinite(float(metrics["loss"]))
+    state, metrics2 = step(state, {"x": tokens})
+    assert float(metrics2["loss"]) < float(metrics["loss"]) + 1.0
+
+
+def test_llama_ring_attention_end_to_end():
+    mesh = par.make_mesh(sp=4)
+    model = get_model("llama-tiny", attention="ring", mesh=mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+    tx = optax.sgd(1e-3)
+    state = train.create_train_state(
+        model, tx, tokens, jax.random.PRNGKey(0), mesh=mesh)
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]),
+        mesh=mesh)
+    state, metrics = step(state, {"x": tokens})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ring_equals_reference_attention_in_model():
+    """Same weights, ring vs reference attention → same logits."""
+    mesh = par.make_mesh(sp=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+    ref_model = get_model("llama-tiny", attention="reference")
+    ring_model = get_model("llama-tiny", attention="ring", mesh=mesh)
+    import flax.linen as nn
+    variables = nn.unbox(ref_model.init(jax.random.PRNGKey(0), tokens))
+    with nn.logical_axis_rules(par.RULES):
+        ref_out = ref_model.apply(variables, tokens)
+        with jax.set_mesh(mesh):
+            ring_out = jax.jit(ring_model.apply)(variables, tokens)
+    np.testing.assert_allclose(np.asarray(ref_out), np.asarray(ring_out),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_resnet_dp_train_step_on_mesh():
+    mesh = par.make_mesh()   # 8-way DP
+    model = get_model("resnet18-thin", dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 10)
+
+    variables = model.init(jax.random.PRNGKey(2), x)
+    import flax.linen as nn
+
+    # BN models carry batch_stats: run a manual step with mutable state.
+    def loss_fn(params, batch_stats):
+        logits, updates = model.apply(
+            {"params": nn.unbox(params), "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"])
+        return train.cross_entropy_loss(logits, y), updates["batch_stats"]
+
+    with jax.set_mesh(mesh):
+        (loss, _), grads = jax.jit(
+            jax.value_and_grad(loss_fn, has_aux=True))(
+            variables["params"], variables["batch_stats"])
+    assert np.isfinite(float(loss))

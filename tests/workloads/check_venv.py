@@ -1,0 +1,15 @@
+"""Asserts the shipped venv was localized and put on PATH (reference test
+fixture analogue: ``check_env_and_venv.py``)."""
+
+import json
+import os
+import shutil
+from pathlib import Path
+
+venv = os.environ.get("VIRTUAL_ENV")
+assert venv, "VIRTUAL_ENV not set"
+tool = shutil.which("tony-venv-marker")
+assert tool, "venv bin/ not on PATH"
+assert Path(tool).read_text().strip() == "#!/bin/sh"
+Path("venv_check.json").write_text(json.dumps({"virtual_env": venv,
+                                               "tool": tool}))

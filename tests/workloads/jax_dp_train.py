@@ -1,0 +1,55 @@
+"""Distributed JAX DP training stub: the SURVEY.md §7 step-5 milestone
+workload. Each process joins the jax.distributed world wired by the
+JAXRuntime env, builds a global 2-device mesh, and trains an MNIST-shaped
+MLP where GSPMD psums gradients across processes. Process 0 writes the loss
+history for the e2e test to assert on."""
+
+import json
+import os
+from pathlib import Path
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import tony_tpu.distributed as dist
+
+initialized = dist.initialize()
+assert initialized, "expected multi-process TonY env"
+
+import jax.numpy as jnp
+import optax
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.models import get_model
+
+mesh = par.MeshSpec(dp=jax.device_count()).build()
+model = get_model("mnist-mlp", hidden=32)
+
+local_batch = 8
+key = jax.random.PRNGKey(jax.process_index())
+x_local = jax.random.normal(key, (local_batch, 784), jnp.float32)
+y_local = jax.random.randint(key, (local_batch,), 0, 10)
+
+state = train.create_train_state(
+    model, optax.adam(1e-2), jnp.zeros((1, 784)), jax.random.PRNGKey(0),
+    mesh=mesh)
+step = train.make_train_step(mesh=mesh)
+
+losses = []
+for _ in range(8):
+    batch = train.global_batch(mesh, {"x": x_local, "y": y_local})
+    state, metrics = step(state, batch)
+    losses.append(float(metrics["loss"]))
+
+assert all(jnp.isfinite(jnp.asarray(losses))), losses
+assert losses[-1] < losses[0], losses
+if jax.process_index() == 0:
+    Path("dp_losses.json").write_text(json.dumps({
+        "losses": losses,
+        "num_processes": jax.process_count(),
+        "num_devices": jax.device_count(),
+    }))
+print(f"rank {jax.process_index()}: loss {losses[0]:.4f} -> {losses[-1]:.4f}")

@@ -75,21 +75,18 @@ class ApplicationMaster:
         self._containers: Dict[str, Container] = {}   # task_id -> live container
         self.final_status = JobStatus.FAILED
         self.final_message = ""
+        self._stop_reason: Optional[str] = None       # set by request_stop
 
     def _log(self, msg: str) -> None:
         if not self.quiet:
             print(f"[tony-am {self.app_id}] {msg}", file=sys.stderr, flush=True)
 
     def request_stop(self, reason: str) -> None:
-        """Graceful external stop (SIGTERM from the client's kill fallback):
-        mark the job KILLED so the monitor loop exits through its normal
-        teardown — containers reaped, events finalized, final status written."""
-        session = self.session
-        if session is not None:
-            with session.lock:
-                if session.job_status == JobStatus.RUNNING:
-                    session.job_status = JobStatus.KILLED
-                    session.final_message = reason
+        """Graceful external stop (SIGTERM from the client's kill fallback).
+        Signal-handler safe: only sets a flag — no locks — and the monitor
+        loop applies it (KILLED → normal teardown: containers reaped, events
+        finalized, final status written)."""
+        self._stop_reason = reason
 
     # -- container plumbing ------------------------------------------------
     def _launch_task(self, session: TonySession, job_type: str,
@@ -110,6 +107,9 @@ class ApplicationMaster:
         src = self.job_dir / "src"
         if src.is_dir():
             env[constants.ENV_SRC_DIR] = str(src)
+        venv = self.conf.get(conf_mod.PYTHON_VENV)
+        if venv and Path(venv).exists():
+            env[constants.ENV_VENV] = str(venv)
         if self.token:
             env[ENV_JOB_TOKEN] = self.token
         container = self.scheduler.launch(ContainerLaunch(
@@ -245,6 +245,12 @@ class ApplicationMaster:
 
                 self._handle_completed_containers(session)
                 self._check_heartbeats(session)
+
+                if self._stop_reason is not None:
+                    with session.lock:
+                        if session.job_status == JobStatus.RUNNING:
+                            session.job_status = JobStatus.KILLED
+                            session.final_message = self._stop_reason
 
                 # Gang timeout applies only before the first barrier pass —
                 # a preemption relaunch transiently un-registers one task and

@@ -50,9 +50,9 @@ def build_conf(args: argparse.Namespace) -> TonyConfig:
     if getattr(args, "name", None):
         cfg.set(conf_mod.APPLICATION_NAME, args.name)
     if getattr(args, "python_venv", None):
-        cfg.set("tony.application.python-venv", args.python_venv)
+        cfg.set(conf_mod.PYTHON_VENV, args.python_venv)
     if getattr(args, "python_binary_path", None):
-        cfg.set("tony.application.python-binary", args.python_binary_path)
+        cfg.set(conf_mod.PYTHON_BINARY, args.python_binary_path)
     cfg.merge_overrides(_parse_conf_overrides(args.conf or []))
     return cfg
 
@@ -111,6 +111,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="history root dir (default: scan client workdir)")
     h.add_argument("--port", type=int, default=19885,
                    help="portal port (for serve)")
+    h.add_argument("--bind", default="127.0.0.1",
+                   help="portal bind address (default loopback; job configs "
+                        "are exposed unauthenticated — widen deliberately)")
     h.set_defaults(fn=cmd_history)
 
     n = sub.add_parser("notebook", help="run a notebook/command in one "

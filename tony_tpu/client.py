@@ -23,6 +23,7 @@ call stack §3.1). Responsibilities carried over:
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import shutil
@@ -32,18 +33,24 @@ import time
 from pathlib import Path
 from typing import Callable, Dict, List, Optional
 
+from tony_tpu import conf as conf_mod
 from tony_tpu import constants
 from tony_tpu.am import AM_ADDRESS_FILE, AM_TOKEN_FILE, FINAL_STATUS_FILE
 from tony_tpu.conf import TonyConfig
 from tony_tpu.rpc import RpcClient
-from tony_tpu.util import child_pythonpath
+from tony_tpu.util import child_pythonpath, default_workdir
 
 _POLL_INTERVAL_S = 0.2
 
 
+_app_seq = itertools.count(1)
+
+
 def new_app_id() -> str:
-    """``app_<epoch>_<pid>`` — same shape as YARN application ids."""
-    return f"app_{int(time.time())}_{os.getpid() % 10000:04d}"
+    """``app_<epoch_ms>_<pid><seq>`` — YARN-shaped, collision-free across
+    processes (ms + pid) and within one process (sequence counter)."""
+    return (f"app_{int(time.time() * 1000)}_"
+            f"{os.getpid() % 10000:04d}{next(_app_seq):03d}")
 
 
 class TonyClient:
@@ -58,8 +65,7 @@ class TonyClient:
                  stream: Optional[object] = None):
         self.conf = conf
         self.src_dir = Path(src_dir) if src_dir else None
-        self.workdir = Path(workdir) if workdir else Path(
-            os.environ.get("TONY_WORK_DIR", Path.home() / ".tony-tpu" / "jobs"))
+        self.workdir = Path(workdir) if workdir else default_workdir()
         self.app_id = app_id or new_app_id()
         self.am_host = am_host
         self.quiet = quiet
@@ -99,6 +105,22 @@ class TonyClient:
             dest = self.job_dir / "src"
             if not dest.exists():
                 shutil.copytree(self.src_dir, dest)
+        # Stage the venv (dir or archive) next to the job, like the
+        # reference's HDFS venv upload; executors localize per container.
+        venv = self.conf.get(conf_mod.PYTHON_VENV)
+        if venv:
+            src = Path(venv)
+            if src.is_dir():
+                staged = self.job_dir / "venv"
+                if not staged.exists():
+                    shutil.copytree(src, staged, symlinks=True)
+            elif src.is_file():
+                staged = self.job_dir / src.name
+                if not staged.exists():
+                    shutil.copy2(src, staged)
+            else:
+                raise FileNotFoundError(f"--python_venv {venv} not found")
+            self.conf.set(conf_mod.PYTHON_VENV, str(staged))
         self.conf.save(self.job_dir / "client-conf.json")
 
     def submit(self) -> None:

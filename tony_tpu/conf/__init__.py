@@ -57,6 +57,8 @@ PREEMPTION_MAX_RETRIES = "tony.container.preemption.max-retries"
 
 HISTORY_LOCATION = "tony.history.location"                    # event-log root dir
 KEYTAB_USER = "tony.keytab.user"                              # accepted, unused (no Kerberos)
+PYTHON_VENV = "tony.application.python-venv"                  # venv dir/archive to ship
+PYTHON_BINARY = "tony.application.python-binary"              # interpreter path (in venv)
 
 # Per-jobtype templates (reference: tony.{jobtype}.{instances,memory,vcores,gpus})
 def instances_key(job_type: str) -> str:

@@ -34,6 +34,7 @@ ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
 ENV_PROCESS_ID = "TONY_PROCESS_ID"
 ENV_NUM_PROCESSES = "TONY_NUM_PROCESSES"
 ENV_LOCAL_DEVICE_IDS = "TONY_LOCAL_DEVICE_IDS"
+ENV_PROFILER_PORT = "TONY_PROFILER_PORT"    # jax.profiler server (§5.1 hook)
 
 # TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
 ENV_TF_CONFIG = "TF_CONFIG"

@@ -32,7 +32,10 @@ def env_spec() -> Optional[tuple[str, int, int]]:
 
 def initialize(local_device_ids: Optional[Sequence[int]] = None) -> bool:
     """Bring up the JAX coordination service from TonY env. Returns True if
-    multi-process init happened, False for the single-process fallback."""
+    multi-process init happened, False for the single-process fallback.
+    Also starts the per-task profiler server when the JAXRuntime enabled it
+    (``tony.task.profiler.enabled`` — SURVEY.md §5.1)."""
+    _maybe_start_profiler()
     spec = env_spec()
     if spec is None:
         return False
@@ -51,6 +54,19 @@ def initialize(local_device_ids: Optional[Sequence[int]] = None) -> bool:
         local_device_ids=local_device_ids,
     )
     return True
+
+
+def _maybe_start_profiler() -> None:
+    """``jax.profiler.start_server`` on the port the JAXRuntime assigned —
+    reachable through ``tony proxy``/TensorBoard for live traces."""
+    port = os.environ.get(constants.ENV_PROFILER_PORT)
+    if not port:
+        return
+    import jax
+    try:
+        jax.profiler.start_server(int(port))
+    except Exception:  # pragma: no cover — port race; profiling is advisory
+        pass
 
 
 def process_id() -> int:

@@ -129,6 +129,7 @@ class TaskExecutor:
         self.conf = TonyConfig.load(e[constants.ENV_CONF_PATH])
         self.host = e.get("TONY_EXECUTOR_HOST", "127.0.0.1")
         self.src_dir = e.get(constants.ENV_SRC_DIR) or None
+        self.venv_path = e.get(constants.ENV_VENV) or None
         self.log_dir = Path(e.get(constants.ENV_LOG_DIR, "."))
         self.token = e.get(ENV_JOB_TOKEN) or None
         self.client = RpcClient(self.am_address, token=self.token,
@@ -157,6 +158,48 @@ class TaskExecutor:
         if not dest.exists():
             shutil.copytree(self.src_dir, dest)
         return dest
+
+    def localize_venv(self) -> Optional[Path]:
+        """Localize the staged venv (dir or archive) into the container
+        sandbox (reference: the venv zip in the YARN LocalResource map)."""
+        if not self.venv_path:
+            return None
+        src = Path(self.venv_path)
+        dest = Path.cwd() / "venv"
+        if dest.exists():
+            return dest
+        if src.is_dir():
+            shutil.copytree(src, dest, symlinks=True)
+        elif src.is_file():
+            shutil.unpack_archive(str(src), str(dest))
+            # Archives often wrap a single top-level dir: flatten to it.
+            entries = list(dest.iterdir())
+            if len(entries) == 1 and entries[0].is_dir() \
+                    and (entries[0] / "bin").is_dir():
+                dest = entries[0]
+        else:
+            return None
+        return dest
+
+    def _venv_env(self, venv: Optional[Path]) -> Dict[str, str]:
+        """PATH/VIRTUAL_ENV entries so ``python`` in the user command
+        resolves inside the shipped venv; ``tony.application.python-binary``
+        (absolute, or relative to the venv) takes precedence."""
+        out: Dict[str, str] = {}
+        paths = []
+        pybin = self.conf.get(conf_mod.PYTHON_BINARY)
+        if pybin:
+            p = Path(pybin)
+            if not p.is_absolute() and venv is not None:
+                p = venv / p
+            paths.append(str(p.parent))
+        if venv is not None:
+            out["VIRTUAL_ENV"] = str(venv)
+            paths.append(str(venv / "bin"))
+        if paths:
+            out["PATH"] = os.pathsep.join(
+                paths + [os.environ.get("PATH", "")])
+        return out
 
     def _heartbeat_loop(self, interval_s: float) -> None:
         while not self._hb_stop.wait(interval_s):
@@ -216,6 +259,7 @@ class TaskExecutor:
             src = self.localize_src()
             cmd = self.user_command()
             env = dict(os.environ)
+            env.update(self._venv_env(self.localize_venv()))
             env.update(task_env)
             if self.token:
                 env[ENV_JOB_TOKEN] = self.token

@@ -20,6 +20,7 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from tony_tpu import events as ev
+from tony_tpu.util import default_workdir
 
 
 def default_history_dir() -> Optional[Path]:
@@ -40,7 +41,7 @@ def gather_jobs(history_dir: Optional[str | Path]) -> List[Dict[str, Any]]:
     root = default_history_dir()
     if root is not None:
         jobs.extend(ev.list_jobs(root))
-    workdir = Path.home() / ".tony-tpu" / "jobs"
+    workdir = default_workdir()
     if workdir.is_dir():
         for jobdir in sorted(workdir.iterdir()):
             h = jobdir / "history"
@@ -188,7 +189,7 @@ class HistoryServer:
     """Tiny threaded HTTP portal over a history root."""
 
     def __init__(self, history_dir: Optional[str | Path],
-                 host: str = "0.0.0.0", port: int = 19885):
+                 host: str = "127.0.0.1", port: int = 19885):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -256,7 +257,10 @@ def main(args) -> int:
         print(render_show(job_detail(job)))
         return 0
     if args.action == "serve":
-        server = HistoryServer(history_dir, port=args.port)
+        # Loopback by default: jhist pages expose full job configs; binding
+        # wider is an explicit opt-in (--bind 0.0.0.0).
+        server = HistoryServer(history_dir, host=getattr(
+            args, "bind", "127.0.0.1") or "127.0.0.1", port=args.port)
         print(f"history portal at http://127.0.0.1:{server.port}/")
         try:
             server.serve_forever()

@@ -1,0 +1,38 @@
+"""Model zoo for the TPU compute plane.
+
+The reference ships models only as *examples* (``tony-examples/``: TF MNIST,
+Keras MNIST, PyTorch MNIST — SURVEY.md §2.2); the orchestrator itself has no
+model code. The TPU rebuild's north star (BASELINE.json via SURVEY.md §6)
+adds two first-class model families this package owns:
+
+* :mod:`~tony_tpu.models.resnet` — ResNet-50 for the ImageNet DP target;
+* :mod:`~tony_tpu.models.transformer` — a Llama-style decoder for the
+  ``pjit``/GSPMD graduation config (SURVEY.md §6 config ⑤), with logical
+  sharding axes wired for dp/fsdp/tp/sp meshes;
+* :mod:`~tony_tpu.models.mnist` — the small nets the examples train.
+
+All models are flax ``linen`` modules: params in f32, compute in bf16 by
+default (MXU-native), logical axis metadata resolved through
+:data:`tony_tpu.parallel.RULES`.
+"""
+
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_model(name: str, **kw):
+    """Build a registered model by name (``resnet50``, ``llama2-7b``,
+    ``llama-tiny``, ``mnist-mlp``, ``mnist-cnn``)."""
+    # Import for registration side effects.
+    from tony_tpu.models import mnist, resnet, transformer  # noqa: F401
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kw)

@@ -1,0 +1,48 @@
+"""MNIST nets: the stand-ins for the reference's example workloads
+(``tony-examples/mnist-tensorflow``, ``mnist-pytorch`` — SURVEY.md §2.2),
+used by ``examples/`` and the distributed-training e2e tests."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tony_tpu.models import register
+
+
+class MLP(nn.Module):
+    hidden: int = 512
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.classes)(x)
+
+
+class CNN(nn.Module):
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:  # flat 784 → NHWC
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256)(x))
+        return nn.Dense(self.classes)(x)
+
+
+@register("mnist-mlp")
+def mnist_mlp(**kw) -> MLP:
+    return MLP(**kw)
+
+
+@register("mnist-cnn")
+def mnist_cnn(**kw) -> CNN:
+    return CNN(**kw)

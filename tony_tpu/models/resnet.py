@@ -1,0 +1,98 @@
+"""ResNet v1.5 for the ImageNet data-parallel north star (SURVEY.md §6:
+ResNet-50 DP ≥55% MFU on a pod slice via ``tony submit``).
+
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU), bf16
+compute with f32 params and f32 batch-norm statistics, and no
+data-dependent control flow — the whole forward is one traced graph. Under
+``jit`` over a dp/fsdp mesh the batch dim is sharded by
+:func:`tony_tpu.parallel.batch_sharding`; BatchNorm's batch-mean then spans
+the *global* batch because arrays are logically global (GSPMD inserts the
+cross-device mean), matching synchronized-BN semantics without any NCCL-style
+explicit allreduce.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tony_tpu.models import register
+
+ModuleDef = Any
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut (v1.5: the
+    stride sits on the 3x3, not the 1x1)."""
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 self.strides, name="proj")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16      # compute dtype; params stay f32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="stem")(x)
+        x = nn.relu(norm(name="stem_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, size in enumerate(self.stage_sizes):
+            for block in range(size):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = Bottleneck(self.width * 2 ** stage, strides,
+                               conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x
+
+
+@register("resnet50")
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+@register("resnet18-thin")
+def resnet18_thin(**kw) -> ResNet:
+    """Small variant for tests: same code path, toy width/depth."""
+    kw.setdefault("width", 8)
+    kw.setdefault("num_classes", 10)
+    return ResNet(stage_sizes=(1, 1), **kw)
+
+
+def resnet50_flops(batch: int, image: int = 224) -> int:
+    """Analytic forward FLOPs (≈4.1 GFLOP @224²); training ≈3× forward.
+    Used by bench.py's MFU computation."""
+    # Standard figure: 4.089e9 MACs*2 fwd for 224x224.
+    per_image = 8.2e9 * (image / 224) ** 2
+    return int(per_image * batch)

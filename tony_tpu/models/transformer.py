@@ -1,0 +1,227 @@
+"""Llama-style decoder for the GSPMD graduation config (SURVEY.md §6
+config ⑤: ``pjit``/GSPMD Llama-2-7B on a pod slice).
+
+TPU-first design:
+
+* bf16 compute / f32 params, RMSNorm in f32 (numerics), rotary embeddings,
+  grouped-query attention, SwiGLU MLP — matmul shapes stay MXU-friendly
+  multiples of 128 in the real configs;
+* every parameter carries flax *logical* axis names
+  (``nn.with_logical_partitioning``); :data:`tony_tpu.parallel.RULES` maps
+  them to the dp/fsdp/tp mesh so GSPMD inserts the tensor-parallel
+  collectives — no hand-written allreduce;
+* attention dispatches through :func:`tony_tpu.ops.flash_attention` (fused
+  pallas kernel on TPU) or :func:`tony_tpu.parallel.ring_attention_sharded`
+  when the sequence axis is sharded (long context, SURVEY.md §5.7);
+* ``scan_layers`` folds the layer stack into one ``nn.scan`` (one trace +
+  one compile of a single block) and ``remat`` wraps blocks in
+  ``jax.checkpoint`` to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models import register
+from tony_tpu.ops import flash_attention, reference_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_hidden: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"        # flash | ring | reference
+    scan_layers: bool = True
+    remat: bool = True
+    mesh: Optional[Any] = None      # required for attention="ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self) -> int:
+        """≈6·N_params matmul FLOPs per trained token (fwd+bwd), plus
+        attention's 12·L·dim·seq term — the standard MFU accounting."""
+        n_params = (
+            self.vocab * self.dim * 2  # embed + unembed
+            + self.n_layers * (
+                self.dim * self.head_dim
+                * (self.n_heads + 2 * self.n_kv_heads)   # wq, wk, wv
+                + self.n_heads * self.head_dim * self.dim  # wo
+                + 3 * self.dim * self.ffn_hidden))
+        return 6 * n_params + 12 * self.n_layers * self.dim * self.max_seq
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over [B, H, T, D] with positions [T]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.with_logical_partitioning(
+            nn.initializers.ones, ("norm",)), (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        # Fused-head projections with rank-2 kernels: (embed, heads·hd)
+        # sharded ('fsdp', 'model') — the megatron TP layout. (DenseGeneral's
+        # multi-dim features initialize flat then reshape, which breaks
+        # logical-metadata unboxing under an active mesh.)
+        dense = lambda feats, logical, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), logical))
+        q = dense(nh * hd, ("embed", "heads"), "wq")(x)
+        k = dense(nkv * hd, ("embed", "kv_heads"), "wk")(x)
+        v = dense(nkv * hd, ("embed", "kv_heads"), "wv")(x)
+        # [B, T, H·D] → [B, H, T, D]
+        q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if nkv != nh:  # GQA: repeat KV heads up to the query head count
+            reps = nh // nkv
+            k = jnp.repeat(k, reps, axis=1)
+            v = jnp.repeat(v, reps, axis=1)
+        if cfg.attention == "ring":
+            from tony_tpu.parallel import ring_attention_sharded
+            assert cfg.mesh is not None, "attention='ring' needs cfg.mesh"
+            out = ring_attention_sharded(q, k, v, cfg.mesh, causal=True)
+        elif cfg.attention == "flash":
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = reference_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd)
+        return dense(cfg.dim, ("heads", "embed"), "wo")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, logical, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), logical))
+        gate = dense(cfg.ffn_hidden, ("embed", "ffn"), "w_gate")(x)
+        up = dense(cfg.ffn_hidden, ("embed", "ffn"), "w_up")(x)
+        y = nn.silu(gate) * up
+        return dense(cfg.dim, ("ffn", "embed"), "w_down")(y)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions)
+        x = x + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return x
+
+
+class ScannedBlock(nn.Module):
+    """Carry-signature wrapper so the layer stack folds into one
+    ``nn.scan`` (single-block trace/compile, stacked params on a leading
+    ``stage`` axis)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return Block(self.cfg, name="block")(x, positions), None
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        _b, t = tokens.shape
+        embed = self.param("embedding", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab, cfg.dim), jnp.float32)
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        positions = jnp.arange(t)
+
+        block_cls = ScannedBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "stage"},
+            )(cfg, name="layers")(x, positions)
+        else:
+            for i in range(cfg.n_layers):
+                x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = nn.DenseGeneral(
+            cfg.vocab, axis=-1, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="lm_head",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")))(x)
+        return logits
+
+
+@register("llama2-7b")
+def llama2_7b(**kw) -> Transformer:
+    return Transformer(TransformerConfig(**kw))
+
+
+@register("llama-tiny")
+def llama_tiny(**kw) -> Transformer:
+    """Test-scale config: same code path as 7B at toy shapes."""
+    defaults = dict(vocab=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                    ffn_hidden=128, max_seq=64, attention="reference",
+                    scan_layers=True, remat=False)
+    defaults.update(kw)
+    return Transformer(TransformerConfig(**defaults))
+

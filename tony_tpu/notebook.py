@@ -25,6 +25,11 @@ def main(args) -> int:
     cfg.set(conf_mod.APPLICATION_FRAMEWORK, "standalone")
     cfg.set("tony.notebook.instances", "1")
     cfg.set("tony.notebook.command", args.executes)
+    # The notebook IS the job here: track it so its exit (clean shutdown or
+    # crash) ends the application with its exit code — an all-untracked
+    # session would never reach a final status and hang the CLI.
+    untracked = [t for t in cfg.untracked_job_types() if t != "notebook"]
+    cfg.set(conf_mod.APPLICATION_UNTRACKED, ",".join(untracked))
     cfg.merge_overrides(_parse_conf_overrides(args.conf or []))
     client = TonyClient(cfg, src_dir=args.src_dir, workdir=args.workdir)
     proxy_holder: dict = {}

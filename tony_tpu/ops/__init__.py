@@ -1,0 +1,13 @@
+"""Hot-path TPU ops: pallas kernels + their portable references.
+
+The reference framework has NO native compute (SURVEY.md §2: TonY is ~100%
+JVM orchestration; kernels live in the frameworks it launches). This package
+is where the TPU rebuild's compute plane keeps its hand-written kernels —
+only the ops where beating XLA's fusion is realistic (attention; XLA already
+fuses elementwise chains and layernorms well). Every op ships with a pure-JAX
+reference implementation used for CPU tests and as the autodiff backward.
+"""
+
+from tony_tpu.ops.attention import flash_attention, reference_attention
+
+__all__ = ["flash_attention", "reference_attention"]

@@ -1,0 +1,152 @@
+"""Parallelism layer: device meshes, sharding rules, and collectives.
+
+The reference delegates ALL parallelism to the launched frameworks (SURVEY.md
+§2.3: PS via ``TF_CONFIG``, ring-allreduce via Horovod/NCCL, DDP via c10d) —
+TonY itself owns no tensor code. This package is the TPU-native replacement
+for that delegated layer, built the way JAX programs scale (SURVEY.md §2.3
+"TPU-build equivalent" column):
+
+* one :class:`MeshSpec` describes the whole parallelism layout
+  (dp/fsdp/tp/sp/ep) and builds a :class:`jax.sharding.Mesh`;
+* parameters and activations carry *logical* axis names; :data:`RULES` maps
+  them onto mesh axes (GSPMD then inserts the collectives — ``psum`` for DP
+  grads over ICI replaces NCCL allreduce, ``all_gather``/``reduce_scatter``
+  for FSDP, ``ppermute`` rings for sequence parallelism);
+* :mod:`tony_tpu.parallel.ring_attention` provides ring attention over the
+  ``seq`` mesh axis for long-context training (SURVEY.md §5.7).
+
+No NCCL, no MPI, no parameter server: the data plane is XLA collectives over
+ICI intra-slice / DCN across slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Mesh axis names, outermost (most DCN-friendly) to innermost (most
+# ICI-bandwidth-hungry). Data-parallel axes first so cross-slice traffic is
+# the cheap gradient allreduce; tensor-parallel innermost so its per-layer
+# collectives ride the fastest ICI links.
+DATA = "data"       # pure data parallel (replicated params)
+FSDP = "fsdp"       # data parallel with sharded params/optimizer (ZeRO-3)
+EXPERT = "expert"   # MoE expert parallelism
+SEQ = "seq"         # sequence/context parallelism (ring attention)
+MODEL = "model"     # tensor parallelism (megatron-style)
+
+AXES: Tuple[str, ...] = (DATA, FSDP, EXPERT, SEQ, MODEL)
+
+# Logical-axis → mesh-axis rules (flax linen logical partitioning format).
+# Parameters: weights shard over fsdp on their "embed"-like dim and over
+# model on their "heads/ffn/vocab"-like dim. Activations: batch over both
+# data axes, sequence over the ring axis.
+RULES: Tuple[Tuple[str, object], ...] = (
+    ("batch", (DATA, FSDP)),
+    ("act_seq", SEQ),
+    ("act_embed", None),   # activations' feature dim (params' "embed" is
+                           # fsdp-sharded; mixing both in one array would
+                           # double-map the fsdp axis)
+    ("act_heads", MODEL),
+    ("embed", FSDP),
+    ("heads", MODEL),
+    ("kv_heads", MODEL),
+    ("ffn", MODEL),
+    ("vocab", MODEL),
+    ("expert", EXPERT),
+    ("stage", None),       # pipeline stages: scan-over-layers axis, unsharded
+    ("norm", None),
+)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """One parallelism layout: how many ways along each axis.
+
+    The product must equal the device count. ``dp`` is accumulated
+    automatically when left at 0: remaining devices go to data parallelism —
+    the common "fill the pod with DP" default.
+    """
+    dp: int = 0
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolved_dp(self, n_devices: int) -> int:
+        rest = self.fsdp * self.ep * self.sp * self.tp
+        if self.dp:
+            return self.dp
+        if n_devices % rest:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fsdp*ep*sp*tp={rest}")
+        return n_devices // rest
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        dp = self.resolved_dp(len(devices))
+        shape = (dp, self.fsdp, self.ep, self.sp, self.tp)
+        if int(np.prod(shape)) != len(devices):
+            raise ValueError(
+                f"mesh shape {dict(zip(AXES, shape))} needs "
+                f"{int(np.prod(shape))} devices, have {len(devices)}")
+        arr = np.asarray(devices).reshape(shape)
+        return Mesh(arr, AXES)
+
+
+def make_mesh(n_devices: Optional[int] = None, **spec_kw) -> Mesh:
+    """Convenience: ``make_mesh(tp=2, sp=4)`` over all (or the first N)
+    local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return MeshSpec(**spec_kw).build(devices)
+
+
+def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
+    """Input-batch sharding: batch dim over both DP axes; optionally the
+    sequence dim over the ring axis (long-context inputs)."""
+    if seq_axis:
+        return NamedSharding(mesh, P((DATA, FSDP), SEQ))
+    return NamedSharding(mesh, P((DATA, FSDP)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def logical_sharding(mesh: Mesh, *logical_axes: Optional[str]) -> NamedSharding:
+    """NamedSharding for an array whose dims carry the given logical axis
+    names (None = unsharded dim), resolved through :data:`RULES`."""
+    table = dict(RULES)
+    spec = tuple(table.get(ax) if ax is not None else None
+                 for ax in logical_axes)
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_logical(mesh: Mesh, x: jax.Array,
+                  *logical_axes: Optional[str]) -> jax.Array:
+    """Device-put ``x`` with :func:`logical_sharding`."""
+    return jax.device_put(x, logical_sharding(mesh, *logical_axes))
+
+
+def constraint(x: jax.Array, mesh: Mesh,
+               *logical_axes: Optional[str]) -> jax.Array:
+    """``with_sharding_constraint`` through the logical-axis rules — the
+    in-jit annotation that steers GSPMD."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, *logical_axes))
+
+
+from tony_tpu.parallel.ring_attention import (  # noqa: E402  (re-export)
+    ring_attention, ring_attention_sharded)
+
+__all__ = [
+    "AXES", "DATA", "FSDP", "EXPERT", "SEQ", "MODEL", "RULES",
+    "MeshSpec", "make_mesh", "batch_sharding", "replicated",
+    "logical_sharding", "shard_logical", "constraint",
+    "ring_attention", "ring_attention_sharded",
+]

@@ -1,0 +1,109 @@
+"""Ring attention: exact attention over sequence-sharded K/V (long context).
+
+Absent from the reference (SURVEY.md §5.7 — TonY predates long-context
+training and owns no tensor code); built here TPU-first per the task's
+long-context requirement. The design is the standard blockwise-parallel ring
+(Liu et al., "Ring Attention with Blockwise Transformers", arXiv:2310.01889,
+public technique): each device holds one sequence shard of Q/K/V; K/V blocks
+rotate around the ``seq`` mesh axis via ``jax.lax.ppermute`` (XLA lowers this
+to ICI neighbor RDMA) while every device accumulates its Q-shard's attention
+with an online-softmax running (max, normalizer, output) triple — so the
+full T×T score matrix never materializes and communication overlaps compute
+in steady state.
+
+Math (fp32 accumulation regardless of input dtype): per incoming block
+``s = q·kᵀ·scale``; ``m' = max(m, rowmax(s))``; ``p = exp(s − m')``;
+``l ← l·exp(m−m') + rowsum(p)``; ``o ← o·exp(m−m') + p·v``; final ``o/l``.
+Causal masking works on *global* positions: Q shard ``r`` attends K shard
+``j`` fully when ``j < r``, causally when ``j == r``, not at all when
+``j > r`` (those steps contribute zeros via the mask).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_step(q, k, v, m, l, o, scale, mask):
+    """One online-softmax accumulation step. q:[B,H,Tq,D] k/v:[B,H,Tk,D]
+    mask:[Tq,Tk] bool (True = attend); m,l:[B,H,Tq,1] o:[B,H,Tq,D], all f32."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # exp(-1e30 - m) underflows to 0, so fully-masked rows stay all-zero.
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention where K/V are sharded along ``axis_name``; call inside
+    ``shard_map``/``pmap`` with per-device shards.
+
+    Shapes (per device): q/k/v ``[batch, heads, seq_shard, head_dim]``;
+    returns ``[batch, heads, seq_shard, head_dim]`` in ``q.dtype``.
+    GQA callers repeat K/V heads up to the Q head count first.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+
+    q_pos = my * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+
+    m0 = jnp.full((b, h, tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, step_idx):
+        k_blk, v_blk, m, l, o = carry
+        j = (my - step_idx) % sp                    # whose shard we hold now
+        if causal:
+            mask = q_pos >= (j * tk + k_iota)
+        else:
+            mask = jnp.ones((tq, tk), bool)
+        m, l, o = _block_step(q, k_blk, v_blk, m, l, o, scale, mask)
+        # Rotate K/V around the ring (skip after the last accumulation).
+        k_nxt, v_nxt = jax.lax.cond(
+            step_idx < sp - 1,
+            lambda: (jax.lax.ppermute(k_blk, axis_name, perm),
+                     jax.lax.ppermute(v_blk, axis_name, perm)),
+            lambda: (k_blk, v_blk))
+        return (k_nxt, v_nxt, m, l, o), None
+
+    (_, _, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(sp))
+    out = jnp.where(l > 0, o / jnp.where(l > 0, l, 1.0), 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, causal: bool = True,
+                           seq_axis: str = "seq",
+                           model_axis: Optional[str] = "model") -> jax.Array:
+    """Global-array entry point: shard_maps :func:`ring_attention` over the
+    mesh. q/k/v are logically-global ``[batch, heads, seq, head_dim]``; the
+    seq dim is sharded over ``seq_axis`` and heads over ``model_axis``."""
+    dp_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    spec = P(dp_axes or None, model_axis, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(q, k, v)

@@ -62,6 +62,11 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
                 hosts.append(spec.rsplit(":", 1)[0] if spec else "")
         env[constants.ENV_TPU_WORKER_ID] = str(rank)
         env[constants.ENV_TPU_WORKER_HOSTNAMES] = ",".join(hosts)
+        # Profiler hook (SURVEY.md §5.1): tony_tpu.distributed.initialize
+        # starts jax.profiler.start_server on this port in the user process.
+        if ctx.conf.get_bool("tony.task.profiler.enabled", False):
+            base = ctx.conf.get_int("tony.task.profiler.port-base", 9431)
+            env[constants.ENV_PROFILER_PORT] = str(base + rank)
         return env
 
 

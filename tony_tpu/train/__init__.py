@@ -1,0 +1,127 @@
+"""Training harness: sharded state, train steps, multi-host data feeding.
+
+The reference has no training loop of its own — user scripts train inside
+whatever framework TonY launched (SURVEY.md §1 L7). The TPU rebuild makes the
+loop a library so examples and benchmarks share one GSPMD path:
+
+* :func:`create_train_state` — init params under ``jit`` with shardings
+  resolved from the model's flax logical axis names through
+  :data:`tony_tpu.parallel.RULES` (optimizer state inherits by propagation);
+* :func:`make_train_step` — one jitted step: loss → grad → update, batch
+  sharded over the DP axes; XLA inserts the gradient ``psum`` over ICI
+  (this IS the Horovod-allreduce/DDP replacement, SURVEY.md §2.3–2.4);
+* :func:`global_batch` — multi-host feeding: each process contributes its
+  local shard of the global batch (``jax.make_array_from_process_local_data``),
+  the executor-side analogue of per-worker data sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training.train_state import TrainState
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu import parallel as par
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross entropy; labels are integer classes (any rank —
+    tokens or images)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels).mean()
+
+
+def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Causal-LM loss: predict token t+1 from position t."""
+    return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+
+
+def param_shardings(model: nn.Module, sample_input: jax.Array, mesh: Mesh,
+                    rng: Optional[jax.Array] = None,
+                    rules=par.RULES) -> Tuple[Any, Any]:
+    """(abstract params, NamedSharding tree) from the model's logical axis
+    metadata — no real initialization happens (eval_shape only)."""
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    with nn.logical_axis_rules(rules):
+        abstract = jax.eval_shape(model.init, rng, sample_input)
+    logical = nn.get_partition_spec(abstract)
+    shardings = nn.logical_to_mesh_sharding(logical, mesh, list(rules))
+    return abstract["params"], shardings["params"]
+
+
+def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
+                       sample_input: jax.Array, rng: jax.Array,
+                       mesh: Optional[Mesh] = None,
+                       rules=par.RULES) -> TrainState:
+    """Initialize a TrainState; with a mesh, params are created already
+    sharded (jit + constraints — no host-memory detour) and the optimizer
+    state inherits the layout via GSPMD propagation."""
+    if mesh is None:
+        params = nn.unbox(model.init(rng, sample_input))["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    _, shardings = param_shardings(model, sample_input, mesh, rng, rules)
+
+    def make(rng):
+        with nn.logical_axis_rules(rules):
+            params = nn.unbox(model.init(rng, sample_input))["params"]
+        params = jax.tree.map(jax.lax.with_sharding_constraint,
+                              params, shardings)
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    with jax.set_mesh(mesh):
+        return jax.jit(make)(rng)
+
+
+def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
+                                      jax.Array] = None,
+                    mesh: Optional[Mesh] = None,
+                    rules=par.RULES,
+                    donate: bool = True):
+    """Build the jitted train step ``(state, batch) -> (state, metrics)``.
+
+    ``loss_of(logits, batch)`` defaults to classification cross entropy on
+    ``batch={'x', 'y'}``. With a mesh, the batch is constrained onto the DP
+    axes so GSPMD shards compute and allreduces grads over ICI.
+    """
+    if loss_of is None:
+        loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        if mesh is not None:
+            batch = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, par.batch_sharding(mesh)), batch)
+
+        def loss_fn(params):
+            with nn.logical_axis_rules(rules):
+                logits = state.apply_fn({"params": params}, batch["x"])
+            return loss_of(logits, batch)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        gnorm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    if mesh is None:
+        return jitted
+
+    def stepper(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+    return stepper
+
+
+def global_batch(mesh: Mesh, local_batch: Dict[str, Any],
+                 seq_axis: bool = False) -> Dict[str, jax.Array]:
+    """Assemble the logically-global batch from this process's local shard —
+    every process calls this with its own slice (multi-host feeding)."""
+    sharding = par.batch_sharding(mesh, seq_axis=seq_axis)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        local_batch)

@@ -11,6 +11,14 @@ from typing import Dict
 PKG_ROOT = str(Path(__file__).resolve().parent.parent)
 
 
+def default_workdir() -> Path:
+    """The client job workdir — TONY_WORK_DIR env or ~/.tony-tpu/jobs.
+    Shared by the client (write side) and history CLI (scan side) so
+    `tony history` finds what `tony submit` wrote."""
+    return Path(os.environ.get("TONY_WORK_DIR",
+                               Path.home() / ".tony-tpu" / "jobs"))
+
+
 def child_pythonpath(env: Dict[str, str]) -> str:
     """PYTHONPATH for a child process that must import ``tony_tpu`` even when
     the parent loaded it off ``sys.path`` (tests / source checkout) rather
