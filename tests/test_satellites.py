@@ -1,0 +1,103 @@
+"""Satellite-module tests: azkaban job-file shim, TPU discovery, TPU-VM
+scheduler command construction (SURVEY.md §2.2 satellites + §2.1 GPU
+discovery analogue)."""
+
+import io
+from pathlib import Path
+
+from tony_tpu import conf as conf_mod
+from tony_tpu.azkaban import job_file_conf, parse_job_file
+from tony_tpu.cli import main as cli_main
+from tony_tpu.discovery import TpuTopology, _chips_from_env, discover_tpus
+from tony_tpu.scheduler import ContainerLaunch, TpuVmScheduler
+
+WORKLOADS = Path(__file__).parent / "workloads"
+
+
+def test_parse_job_file_properties_format(tmp_path):
+    job = tmp_path / "train.job"
+    job.write_text(
+        "# a comment\n"
+        "! another\n"
+        "type=TonYJob\n"
+        "job.name=nightly-train\n"
+        "executes=python train.py \\\n"
+        "  --epochs 3\n"
+        "tony.worker.instances=4\n"
+        "tony.worker.tpus=2\n")
+    props = parse_job_file(job)
+    assert props["type"] == "TonYJob"
+    assert props["executes"] == "python train.py --epochs 3"
+    assert props["tony.worker.instances"] == "4"
+
+
+def test_job_file_conf_translation(tmp_path):
+    job = tmp_path / "train.job"
+    job.write_text(
+        "job.name=nightly\n"
+        "framework=jax\n"
+        "src.dir=/data/src\n"
+        "executes=python train.py\n"
+        "tony.worker.instances=2\n")
+    cfg, src_dir = job_file_conf(job)
+    assert src_dir == "/data/src"
+    assert cfg.get(conf_mod.APPLICATION_NAME) == "nightly"
+    assert cfg.get(conf_mod.APPLICATION_FRAMEWORK) == "jax"
+    assert cfg.get("tony.application.executes") == "python train.py"
+    assert cfg.instances("worker") == 2
+
+
+def test_azkaban_cli_submits_end_to_end(tmp_path):
+    job = tmp_path / "smoke.job"
+    job.write_text(
+        "framework=standalone\n"
+        f"src.dir={WORKLOADS}\n"
+        "executes=python exit_0.py\n"
+        "tony.worker.instances=1\n"
+        "tony.task.heartbeat-interval-ms=200\n")
+    rc = cli_main(["azkaban", str(job), "--workdir", str(tmp_path / "jobs"),
+                   "--timeout", "90"])
+    assert rc == 0
+
+
+def test_discovery_env_paths():
+    assert _chips_from_env({"TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1"}) == 4
+    assert _chips_from_env({"TPU_VISIBLE_DEVICES": "0,1,2"}) == 3
+    assert _chips_from_env({}) is None
+    topo = discover_tpus()
+    assert isinstance(topo, TpuTopology)
+    assert topo.num_chips >= 0
+
+
+def test_am_rejects_tpu_ask_on_chipless_host(tmp_path, monkeypatch):
+    """tpus>0 with zero discovered chips must fail loudly, not become an
+    unlimited-scheduler launch; tony.scheduler.total-tpus overrides."""
+    import pytest
+    from tony_tpu.am import ApplicationMaster
+    from tony_tpu.conf import TonyConfig
+    import tony_tpu.discovery as disc
+    monkeypatch.setattr(disc, "discover_tpus",
+                        lambda use_jax=False: disc.TpuTopology(0, "none"))
+    props = {"tony.worker.instances": "1", "tony.worker.tpus": "4",
+             "tony.application.framework": "standalone"}
+    with pytest.raises(ValueError, match="no TPU chips"):
+        ApplicationMaster(TonyConfig(props), "app_t", tmp_path / "j")
+    am = ApplicationMaster(
+        TonyConfig({**props, "tony.scheduler.total-tpus": "8"}),
+        "app_t2", tmp_path / "j2")
+    assert am.scheduler.total_tpus == 8
+
+
+def test_tpuvm_scheduler_remote_command():
+    sched = TpuVmScheduler(hosts=["10.0.0.1", "10.0.0.2"],
+                           remote_workdir="/tmp/tt")
+    launch = ContainerLaunch(job_type="worker", index=0,
+                             env={"TONY_JOB_NAME": "worker",
+                                  "TONY_AM_ADDRESS": "10.0.0.9:1234"})
+    argv = sched.build_remote_command(launch, "10.0.0.1")
+    assert argv[0] == "ssh" and argv[1] == "10.0.0.1"
+    remote = argv[2]
+    assert "mkdir -p /tmp/tt" in remote
+    assert "export TONY_AM_ADDRESS=10.0.0.9:1234;" in remote
+    assert "export TONY_EXECUTOR_HOST=10.0.0.1;" in remote
+    assert remote.endswith("python3 -m tony_tpu.executor")

@@ -1,0 +1,43 @@
+"""Horovod-semantics-on-ICI stub (graduation config ④, SURVEY.md §6): the
+job sees the full HOROVOD_* contract, but its allreduce is an XLA
+cross-process reduction over the coordinator triple the HorovodRuntime also
+exported — the NCCL→ICI replacement, live."""
+
+import json
+import os
+from pathlib import Path
+
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+assert os.environ["HOROVOD_CONTROLLER"] == "tony"
+assert os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+assert int(os.environ["HOROVOD_LOCAL_SIZE"]) >= 1
+assert int(os.environ["HOROVOD_CROSS_SIZE"]) >= 1
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import tony_tpu.distributed as dist
+
+assert dist.initialize(), "coordinator triple missing"
+assert dist.process_id() == rank and dist.num_processes() == size
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# The ring-allreduce moment: every process contributes its rank; the jitted
+# sum over the process-sharded global array is the cross-host collective.
+mesh = Mesh(jax.devices(), ("data",))
+n_local = jax.local_device_count()
+local = jnp.full((n_local,), rank, jnp.int32)
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local)
+total = int(jax.jit(
+    jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr))
+expected = sum(r * n_local for r in range(size))
+assert total == expected, (total, expected)
+Path(f"hvd_rank{rank}.json").write_text(json.dumps({
+    "rank": rank, "size": size, "allreduce": total}))
+print(f"hvd rank {rank}/{size}: allreduce={total}")

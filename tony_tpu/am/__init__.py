@@ -55,8 +55,27 @@ class ApplicationMaster:
         # to them (conf, src) must be absolute.
         self.job_dir = Path(job_dir).resolve()
         self.job_dir.mkdir(parents=True, exist_ok=True)
-        self.scheduler = scheduler or LocalProcessScheduler(
-            self.job_dir, host=host, conf=conf)
+        if scheduler is None:
+            # Local substrate: enforce chip asks against what this host
+            # actually has (reference: GpuDiscoverer feeding the AM's
+            # resource accounting) whenever any job type requests tpus.
+            total_tpus = 0
+            if any(conf.get_int(conf_mod.tpus_key(jt), 0) > 0
+                   for jt in conf.job_types()):
+                total_tpus = conf.get_int(conf_mod.SCHEDULER_TOTAL_TPUS, 0)
+                if total_tpus <= 0:
+                    from tony_tpu.discovery import discover_tpus
+                    total_tpus = discover_tpus(use_jax=True).num_chips
+                if total_tpus <= 0:
+                    # 0 would mean "unlimited" to the scheduler — the
+                    # opposite of what an unsatisfiable ask deserves.
+                    raise ValueError(
+                        "tony.<jobtype>.tpus requested but no TPU chips "
+                        "discovered on this host; set "
+                        f"{conf_mod.SCHEDULER_TOTAL_TPUS} to override")
+            scheduler = LocalProcessScheduler(
+                self.job_dir, host=host, conf=conf, total_tpus=total_tpus)
+        self.scheduler = scheduler
         self.host = host
         self.quiet = quiet
         self.token: Optional[str] = None

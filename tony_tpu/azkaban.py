@@ -1,0 +1,75 @@
+"""Azkaban-style job-file submission shim.
+
+Mirrors ``tony-azkaban`` (upstream ``tony-azkaban/src/main/java/com/linkedin/
+tony/azkaban/TonyJob.java``, unverified — SURVEY.md §0/§2.2): the scheduler
+plugin that turns a declarative job file (``type=TonYJob`` + java-properties
+key/values) into a TonY submission. Here the shim is scheduler-agnostic —
+any workflow engine that can run a shell command uses::
+
+    tony azkaban myjob.job
+
+Job-file keys map as in the reference plugin: every ``tony.*`` property
+passes through to the job config verbatim; the Azkaban-side wrapper keys
+translate to their client flags (``src.dir`` → ``--src_dir``,
+``hadoop.command`` / ``executes`` → the task command).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional
+
+from tony_tpu import conf as conf_mod
+from tony_tpu.conf import TonyConfig
+
+# Azkaban wrapper-key → config-key translation (non-"tony." keys).
+_WRAPPER_KEYS = {
+    "executes": "tony.application.executes",
+    "hadoop.command": "tony.application.executes",
+    "job.name": conf_mod.APPLICATION_NAME,
+    "framework": conf_mod.APPLICATION_FRAMEWORK,
+    "python.venv": conf_mod.PYTHON_VENV,
+    "python.binary.path": conf_mod.PYTHON_BINARY,
+}
+
+
+def parse_job_file(path: str | Path) -> Dict[str, str]:
+    """Java-properties parser: ``key=value`` lines, ``#``/``!`` comments,
+    trailing-backslash continuations (the format Azkaban job files use)."""
+    props: Dict[str, str] = {}
+    pending = ""
+    for raw in Path(path).read_text().splitlines():
+        line = pending + raw.strip()
+        pending = ""
+        if not line or line[0] in "#!":
+            continue
+        if line.endswith("\\"):
+            pending = line[:-1]
+            continue
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        props[key.strip()] = value.strip()
+    return props
+
+
+def job_file_conf(path: str | Path) -> tuple[TonyConfig, Optional[str]]:
+    """(config, src_dir) from a job file: ``tony.*`` keys pass through,
+    wrapper keys translate (reference: ``TonyJob#getJobProps``)."""
+    props = parse_job_file(path)
+    cfg = TonyConfig()
+    src_dir = props.get("src.dir") or props.get("working.dir")
+    for key, value in props.items():
+        if key.startswith("tony."):
+            cfg.set(key, value)
+        elif key in _WRAPPER_KEYS:
+            cfg.set(_WRAPPER_KEYS[key], value)
+    return cfg, src_dir
+
+
+def main(args) -> int:
+    from tony_tpu.client import TonyClient
+    cfg, src_dir = job_file_conf(args.job_file)
+    client = TonyClient(cfg, src_dir=src_dir,
+                        workdir=getattr(args, "workdir", None))
+    return client.run(timeout=getattr(args, "timeout", None))

@@ -75,6 +75,11 @@ def cmd_notebook(args: argparse.Namespace) -> int:
     return notebook_main(args)
 
 
+def cmd_azkaban(args: argparse.Namespace) -> int:
+    from tony_tpu.azkaban import main as azkaban_main
+    return azkaban_main(args)
+
+
 def cmd_version(_args: argparse.Namespace) -> int:
     print(f"tony-tpu {__version__}")
     return 0
@@ -127,6 +132,14 @@ def make_parser() -> argparse.ArgumentParser:
     n.add_argument("--port", type=int, default=0,
                    help="local proxy port (0 = ephemeral)")
     n.set_defaults(fn=cmd_notebook)
+
+    a = sub.add_parser("azkaban", help="submit from an Azkaban-style "
+                       ".job properties file")
+    a.add_argument("job_file", help="java-properties job file "
+                   "(tony.* keys pass through)")
+    a.add_argument("--workdir", help="client work dir")
+    a.add_argument("--timeout", type=float, default=None)
+    a.set_defaults(fn=cmd_azkaban)
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=cmd_version)

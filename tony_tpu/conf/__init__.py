@@ -52,11 +52,10 @@ AM_MEMORY = "tony.am.memory"
 AM_VCORES = "tony.am.vcores"
 AM_GANG_TIMEOUT_MS = "tony.am.gang-allocation-timeout-ms"     # all-registered barrier timeout
 
-CONTAINER_ALLOCATION_TIMEOUT_MS = "tony.container.allocation-timeout-ms"
 PREEMPTION_MAX_RETRIES = "tony.container.preemption.max-retries"
 
 HISTORY_LOCATION = "tony.history.location"                    # event-log root dir
-KEYTAB_USER = "tony.keytab.user"                              # accepted, unused (no Kerberos)
+SCHEDULER_TOTAL_TPUS = "tony.scheduler.total-tpus"            # chip-census override
 PYTHON_VENV = "tony.application.python-venv"                  # venv dir/archive to ship
 PYTHON_BINARY = "tony.application.python-binary"              # interpreter path (in venv)
 
@@ -103,7 +102,6 @@ DEFAULTS: Dict[str, str] = {
     AM_MEMORY: "2g",
     AM_VCORES: "1",
     AM_GANG_TIMEOUT_MS: "120000",
-    CONTAINER_ALLOCATION_TIMEOUT_MS: "120000",
     PREEMPTION_MAX_RETRIES: "3",
     HISTORY_LOCATION: "",
 }
