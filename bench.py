@@ -110,7 +110,7 @@ def main() -> int:
     peak = PEAK_BF16.get(gen, PEAK_BF16["v5e"]) if on_tpu else 1e12
     mfu = train_flops_per_step * steps / elapsed / peak
 
-    print(json.dumps({
+    result = {
         "metric": "resnet50_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_bf16_peak",
@@ -121,8 +121,60 @@ def main() -> int:
         "backend": backend,
         "chip": gen,
         "loss": float(loss),
-    }))
+    }
+    if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
+        try:
+            result.update(bench_llm(peak))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["llm_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result))
     return 0
+
+
+def bench_llm(peak: float) -> dict:
+    """Secondary metric: a matmul-dominated Llama-style train step (the
+    GSPMD graduation config ⑤'s single-chip core), same fencing rules."""
+    import optax
+
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+
+    batch = int(os.environ.get("BENCH_LLM_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_LLM_SEQ", "1024"))
+    remat = os.environ.get("BENCH_LLM_REMAT", "0") == "1"
+    model = get_model(
+        "llama2-7b", dim=1024, n_layers=12, n_heads=16, n_kv_heads=16,
+        ffn_hidden=4096, vocab=32768, max_seq=seq, attention="flash",
+        scan_layers=True, remat=remat)
+    cfg = model.cfg
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab)
+    state = tr.create_train_state(
+        model, optax.adamw(1e-4), tokens, jax.random.PRNGKey(1))
+    step = tr.make_train_step(
+        loss_of=lambda logits, b: tr.next_token_loss(logits, b["x"]))
+
+    steps = int(os.environ.get("BENCH_LLM_STEPS", "10"))
+    for _ in range(max(4, steps // 2)):
+        state, metrics = step(state, {"x": tokens})
+    float(metrics["loss"])
+    best = float("inf")
+    for _ in range(int(os.environ.get("BENCH_WINDOWS", "3"))):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, {"x": tokens})
+        float(metrics["loss"])
+        float(jax.tree_util.tree_leaves(state.params)[0].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / best
+    mfu = cfg.flops_per_token() * tokens_per_sec / peak
+    return {
+        "llm_mfu": round(mfu, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "llm_batch": batch,
+        "llm_seq": seq,
+    }
 
 
 if __name__ == "__main__":

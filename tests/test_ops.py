@@ -46,15 +46,18 @@ def test_flash_kernel_bf16():
                                atol=2e-2, rtol=2e-2)
 
 
-def test_flash_grad_matches_reference_grad():
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grad_matches_reference_grad(causal):
     q, k, v = rand_qkv(b=1, h=2, t=32, d=8)
+    # Non-uniform cotangent so dq/dk/dv all get exercised asymmetrically.
+    w = jax.random.normal(jax.random.PRNGKey(7), (1, 2, 32, 8))
 
     def loss_flash(q, k, v):
-        return flash_attention(q, k, v, block_q=16, block_k=16,
-                               interpret=True).sum()
+        return (flash_attention(q, k, v, causal=causal, block_q=16,
+                                block_k=16, interpret=True) * w).sum()
 
     def loss_ref(q, k, v):
-        return reference_attention(q, k, v).sum()
+        return (reference_attention(q, k, v, causal=causal) * w).sum()
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)

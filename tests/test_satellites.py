@@ -88,6 +88,70 @@ def test_am_rejects_tpu_ask_on_chipless_host(tmp_path, monkeypatch):
     assert am.scheduler.total_tpus == 8
 
 
+def test_tpuvm_scheduler_fake_ssh_e2e(tmp_path):
+    """The multi-host path end-to-end with ssh faked as a local shim: conf +
+    src stage over the tar|ssh pipeline, the executor launches 'remotely',
+    registers, runs the workload, and the job succeeds."""
+    import os
+    import stat
+    import sys
+
+    from tony_tpu.am import ApplicationMaster
+    from tony_tpu.conf import TonyConfig
+    from tony_tpu.minipod import MiniPodJob
+    from tony_tpu.util import PKG_ROOT
+
+    fake = tmp_path / "fakessh.sh"
+    fake.write_text("#!/bin/sh\nshift\nexec sh -c \"$*\"\n")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    conf = TonyConfig({
+        "tony.application.framework": "standalone",
+        "tony.worker.instances": "1",
+        "tony.application.executes": "python exit_0.py",
+        "tony.task.heartbeat-interval-ms": "200",
+    })
+    job_dir = tmp_path / "job"
+    (job_dir / "src").mkdir(parents=True)
+    import shutil
+    for wl in ("exit_0.py",):
+        shutil.copy(WORKLOADS / wl, job_dir / "src" / wl)
+    sched = TpuVmScheduler(
+        hosts=["localhost"], ssh_cmd=str(fake),
+        remote_python=sys.executable,
+        remote_workdir=str(tmp_path / "remote"),
+        remote_pythonpath=PKG_ROOT)
+    am = ApplicationMaster(conf, app_id="app_tpuvm", job_dir=job_dir,
+                           scheduler=sched)
+    job = MiniPodJob(am).start()
+    assert job.wait(timeout=90) == 0
+    # The remote workdir really was staged and used.
+    assert (tmp_path / "remote" / "src" / "exit_0.py").is_file()
+    assert (tmp_path / "remote" / "conf" / "tony-job.json").is_file()
+
+
+def test_scheduler_from_conf_backends(tmp_path):
+    import pytest
+    from tony_tpu.conf import TonyConfig
+    from tony_tpu.scheduler import scheduler_from_conf
+    # local (default) → None: caller builds LocalProcessScheduler.
+    assert scheduler_from_conf(TonyConfig(), tmp_path) is None
+    # tpu-vm honors hosts and the node blacklist.
+    sched = scheduler_from_conf(TonyConfig({
+        "tony.scheduler.backend": "tpu-vm",
+        "tony.scheduler.hosts": "10.0.0.1,10.0.0.2,10.0.0.3",
+        "tony.application.node-blacklist": "10.0.0.2",
+    }), tmp_path)
+    assert isinstance(sched, TpuVmScheduler)
+    assert sched.hosts == ["10.0.0.1", "10.0.0.3"]
+    with pytest.raises(ValueError, match="needs tony.scheduler.hosts"):
+        scheduler_from_conf(TonyConfig({
+            "tony.scheduler.backend": "tpu-vm"}), tmp_path)
+    with pytest.raises(ValueError, match="unknown tony.scheduler.backend"):
+        scheduler_from_conf(TonyConfig({
+            "tony.scheduler.backend": "k8s"}), tmp_path)
+
+
 def test_tpuvm_scheduler_remote_command():
     sched = TpuVmScheduler(hosts=["10.0.0.1", "10.0.0.2"],
                            remote_workdir="/tmp/tt")

@@ -14,22 +14,26 @@ Reference parity map (upstream paths, see SURVEY.md; the reference mount was
 empty so citations are upstream-relative, class-level):
 
 ==========================================  =========================================
-Reference (Java)                            This package (Python/JAX/C-ext)
+Reference (Java)                            This package (Python/JAX)
 ==========================================  =========================================
 tony-core TonyConfigurationKeys             tony_tpu.conf
 tony-core TonySession / TonyTask            tony_tpu.session
-tony-core rpc/* (Hadoop RPC + protobuf)     tony_tpu.rpc (gRPC, JSON wire)
+tony-core rpc/* (Hadoop RPC + protobuf)     tony_tpu.rpc (JSON-lines TCP)
 tony-core TaskExecutor / TaskMonitor        tony_tpu.executor
 tony-core TonyApplicationMaster             tony_tpu.am
 tony-core Framework SPI + runtime/*         tony_tpu.runtime
 tony-core events/* (Avro jhist)             tony_tpu.events (JSONL jhist)
 tony-core TonyClient                        tony_tpu.client
-tony-cli ClusterSubmitter/NotebookSubmitter tony_tpu.cli
+tony-core util/gpu/GpuDiscoverer            tony_tpu.discovery
+tony-cli ClusterSubmitter                   tony_tpu.cli
+tony-cli NotebookSubmitter                  tony_tpu.notebook
+tony-azkaban TonyJob plugin                 tony_tpu.azkaban
 tony-history-server (Play portal)           tony_tpu.history
 tony-proxy ProxyServer                      tony_tpu.proxy
 tony-mini (docker pseudo-cluster)           tony_tpu.minipod (in-process)
-(no reference analogue; TPU compute plane)  tony_tpu.models / ops / parallel / train
+(delegated to ML frameworks in reference)   tony_tpu.models / ops / parallel / train
+(user-side in reference)                    tony_tpu.distributed / checkpoint
 ==========================================  =========================================
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -56,6 +56,10 @@ class ApplicationMaster:
         self.job_dir = Path(job_dir).resolve()
         self.job_dir.mkdir(parents=True, exist_ok=True)
         if scheduler is None:
+            # Config-selected backend (tpu-vm) or fall through to local.
+            from tony_tpu.scheduler import scheduler_from_conf
+            scheduler = scheduler_from_conf(conf, self.job_dir, host)
+        if scheduler is None:
             # Local substrate: enforce chip asks against what this host
             # actually has (reference: GpuDiscoverer feeding the AM's
             # resource accounting) whenever any job type requests tpus.

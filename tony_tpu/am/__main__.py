@@ -26,7 +26,13 @@ def main(argv=None) -> int:
     # teardown instead of dying mid-loop and orphaning executor groups.
     signal.signal(signal.SIGTERM,
                   lambda _sig, _frm: am.request_stop("AM received SIGTERM"))
-    return am.run()
+    try:
+        return am.run()
+    except Exception as e:  # noqa: BLE001 — AM-internal failure, not job's
+        from tony_tpu import constants
+        print(f"[tony-am] internal error: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return constants.EXIT_AM_ERROR
 
 
 if __name__ == "__main__":

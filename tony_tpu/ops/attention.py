@@ -46,10 +46,17 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
+def _causal_mask(s, qi, bq, kb, block_k):
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, scale: float):
     """One grid cell: q-block [Bq, D] against the full K/V [T, D] in VMEM,
-    streamed in block_k chunks through the online-softmax recurrence."""
+    streamed in block_k chunks through the online-softmax recurrence. Also
+    writes the log-sum-exp rows the backward kernels reconstruct p from."""
     bq, d = q_ref.shape
     t = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -73,11 +80,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [Bq, Bk]
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi, bq, kb, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -88,7 +91,89 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, a0))
-    o_ref[:] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe)).reshape(bq)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                         *, block_k: int, causal: bool, scale: float):
+    """dq for one q-block: recompute p from (q, k, lse) per k-block —
+    ds = p·(dpᵀ−D); dq += ds·k·scale. No T×T buffer ever materializes."""
+    bq, d = q_ref.shape
+    t = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    o = o_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].reshape(bq, 1)
+    D = jnp.sum(do * o, axis=-1, keepdims=True)          # [Bq, 1]
+    num_kb = pl.cdiv((qi + 1) * bq, block_k) if causal else pl.cdiv(
+        t, block_k)
+
+    def body(kb, dq):
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, bq, kb, block_k)
+        p = jnp.exp(s - lse)                              # exact softmax
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - D)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float):
+    """dk/dv for one k-block: iterate q-blocks (from the diagonal down when
+    causal): dv += pᵀ·do; dk += dsᵀ·q·scale."""
+    bk, d = k_ref.shape
+    t = q_ref.shape[0]
+    kj = pl.program_id(1)
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    num_qb = pl.cdiv(t, block_q)
+    qb0 = (kj * bk) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qb, block_q, kj, bk)
+        p = jnp.exp(s - lse)                              # [Bq, Bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        D = jnp.sum(do * o, axis=-1, keepdims=True)
+        ds = p * (dp - D)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        return dk_new, dv_new
+
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb0, num_qb, body, (zeros, zeros))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -100,7 +185,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     vr = v.reshape(b * h, tk, d)
     kernel = functools.partial(_flash_kernel, block_k=block_k,
                                causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -108,37 +193,78 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, i: (bh, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * t * tk * d // (2 if causal else 1),
             bytes_accessed=(qr.size + kr.size + vr.size) * q.dtype.itemsize,
             transcendentals=b * h * t * tk),
     )(qr, kr, vr)
-    return out.reshape(b, h, t, d)
+    return out.reshape(b, h, t, d), lse.reshape(b, h, t)
+
+
+def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
+                    interpret):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
+    dor, outr = do.reshape(bh, t, d), o.reshape(bh, t, d)
+    lser = lse.reshape(bh, t)
+    q_spec = pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0))
+    kv_full = pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0))
+    q_full = pl.BlockSpec((None, t, d), lambda g, i: (g, 0, 0))
+    lse_blk = pl.BlockSpec((None, block_q), lambda g, i: (g, i))
+    lse_full = pl.BlockSpec((None, t), lambda g, i: (g, 0))
+    k_spec = pl.BlockSpec((None, block_k, d), lambda g, j: (g, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(bh, pl.cdiv(t, block_q)),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, q_spec, lse_blk],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, outr, lser)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale),
+        grid=(bh, pl.cdiv(tk, block_k)),
+        in_specs=[q_full, k_spec, k_spec, q_full, q_full, lse_full],
+        out_specs=(k_spec, k_spec),
+        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        interpret=interpret,
+    )(qr, kr, vr, dor, outr, lser)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    # Recompute-based backward via the reference VJP: no T×T residuals were
-    # saved by the forward (flash's whole point); the reference recompute is
-    # one fused XLA graph. A dedicated pallas backward kernel can slot in
-    # here without touching callers.
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal, scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, g, out, lse, causal, scale, block_q,
+                           block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -198,6 +198,34 @@ class LocalProcessScheduler(ContainerScheduler):
             time.sleep(0.05)
 
 
+def scheduler_from_conf(conf, job_dir: str | Path,
+                        host: str = "127.0.0.1") -> ContainerScheduler:
+    """Build the substrate the config names (reference: the RM is chosen by
+    the cluster, not the job; here ``tony.scheduler.backend`` picks
+    ``local`` (default) or ``tpu-vm``). ``tony.application.node-blacklist``
+    hosts are excluded from placement — the reference's blacklist semantics
+    applied at scheduler level."""
+    from tony_tpu import conf as conf_mod
+    backend = conf.get("tony.scheduler.backend", "local")
+    blacklist = set(conf.get_list(conf_mod.APPLICATION_NODE_BLACKLIST))
+    if backend == "tpu-vm":
+        hosts = [h for h in conf.get_list("tony.scheduler.hosts")
+                 if h not in blacklist]
+        if not hosts:
+            raise ValueError(
+                "tony.scheduler.backend=tpu-vm needs tony.scheduler.hosts "
+                "(after node-blacklist filtering)")
+        return TpuVmScheduler(
+            hosts,
+            ssh_cmd=conf.get("tony.scheduler.ssh-command", "ssh"),
+            remote_python=conf.get("tony.scheduler.remote-python", "python3"),
+            remote_workdir=conf.get("tony.scheduler.remote-workdir",
+                                    "/tmp/tony-tpu"))
+    if backend != "local":
+        raise ValueError(f"unknown tony.scheduler.backend={backend!r}")
+    return None  # caller builds LocalProcessScheduler with its own args
+
+
 def docker_wrap_command(conf, argv: List[str]) -> List[str]:
     """When ``tony.docker.enabled`` is set, wrap an executor launch command in
     ``docker run`` with the configured image (reference: the YARN docker
@@ -228,24 +256,47 @@ class TpuVmScheduler(ContainerScheduler):
 
     def __init__(self, hosts: List[str], ssh_cmd: str = "ssh",
                  remote_python: str = "python3",
-                 remote_workdir: str = "/tmp/tony-tpu"):
+                 remote_workdir: str = "/tmp/tony-tpu",
+                 remote_pythonpath: Optional[str] = None):
         if not hosts:
             raise ValueError("TpuVmScheduler requires at least one host")
         self.hosts = list(hosts)
         self.ssh_cmd = ssh_cmd
         self.remote_python = remote_python
         self.remote_workdir = remote_workdir
+        self.remote_pythonpath = remote_pythonpath  # None = pip-installed
         self._running: Dict[str, Container] = {}
         self._lock = threading.Lock()
         self._next_id = 0
+        self._staged_hosts: set = set()
+
+    def build_stage_command(self, local_dir: str, host: str,
+                            remote_subdir: str, items: str = ".") -> str:
+        """Shell pipeline staging a local dir (or named items within it)
+        onto the worker (the HDFS localization analogue for the SSH
+        substrate): tar stream over ssh — no temp files, one round trip."""
+        dest = f"{self.remote_workdir}/{remote_subdir}"
+        return (f"tar -C {shlex.quote(local_dir)} -cf - {items} | "
+                f"{self.ssh_cmd} {host} "
+                f"{shlex.quote(f'mkdir -p {dest} && tar -xf - -C {dest}')}")
 
     def build_remote_command(self, launch: ContainerLaunch,
                              host: str) -> List[str]:
         """The SSH argv for one executor launch (separated for testability:
-        command construction is covered by unit tests, the network is not)."""
+        command construction is covered by unit tests, the network is not).
+        Paths in the env that point at client-side staging (conf, src) are
+        rewritten to the worker-side copies laid down by
+        :meth:`build_stage_command`."""
+        env = {**launch.env, "TONY_EXECUTOR_HOST": host}
+        if constants.ENV_CONF_PATH in env:
+            env[constants.ENV_CONF_PATH] = (
+                f"{self.remote_workdir}/conf/{constants.TONY_JOB_JSON}")
+        if constants.ENV_SRC_DIR in env:
+            env[constants.ENV_SRC_DIR] = f"{self.remote_workdir}/src"
+        if self.remote_pythonpath:
+            env["PYTHONPATH"] = self.remote_pythonpath
         exports = " ".join(
-            f"export {k}={shlex.quote(v)};" for k, v in
-            sorted({**launch.env, "TONY_EXECUTOR_HOST": host}.items()))
+            f"export {k}={shlex.quote(v)};" for k, v in sorted(env.items()))
         remote = (f"mkdir -p {self.remote_workdir} && cd {self.remote_workdir} "
                   f"&& {exports} {self.remote_python} -m tony_tpu.executor")
         return [self.ssh_cmd, host, remote]
@@ -255,11 +306,29 @@ class TpuVmScheduler(ContainerScheduler):
             host = self.hosts[self._next_id % len(self.hosts)]
         return host
 
+    def _stage_once(self, launch: ContainerLaunch, host: str) -> None:
+        """Stage conf + src onto the worker the first time it's used."""
+        with self._lock:
+            if host in self._staged_hosts:
+                return
+            self._staged_hosts.add(host)
+        conf_path = launch.env.get(constants.ENV_CONF_PATH)
+        if conf_path and Path(conf_path).is_file():
+            subprocess.run(
+                self.build_stage_command(str(Path(conf_path).parent), host,
+                                         "conf", items=Path(conf_path).name),
+                shell=True, check=False, timeout=300)
+        src_dir = launch.env.get(constants.ENV_SRC_DIR)
+        if src_dir and Path(src_dir).is_dir():
+            subprocess.run(self.build_stage_command(src_dir, host, "src"),
+                           shell=True, check=False, timeout=300)
+
     def launch(self, launch: ContainerLaunch) -> Container:
         host = self._host_for(launch)
         with self._lock:
             self._next_id += 1
             cid = f"container_tpuvm_{self._next_id:04d}"
+        self._stage_once(launch, host)
         proc = subprocess.Popen(
             self.build_remote_command(launch, host),
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
