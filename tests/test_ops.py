@@ -28,10 +28,30 @@ def test_flash_kernel_matches_reference(causal):
 
 
 def test_flash_kernel_uneven_blocks():
-    # block sizes that don't divide T fall back to the reference — still exact.
+    # Causal self-attention with T not divisible by the blocks takes the
+    # zero-pad kernel path — still exact.
     q, k, v = rand_qkv(t=48)
     out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
     ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_ragged_noncausal_falls_back_with_warning():
+    # Non-causal ragged shapes can't use end-padding (padded keys would
+    # soak up softmax mass) — they fall back to the reference, loudly.
+    import warnings
+
+    from tony_tpu.ops import attention as att
+
+    q, k, v = rand_qkv(t=48, tk=40)
+    att._warned.clear()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32,
+                              interpret=True)
+    assert any("falling back" in str(w.message) for w in caught)
+    ref = reference_attention(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
 
@@ -64,6 +84,42 @@ def test_flash_grad_matches_reference_grad(causal):
     for a, b in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-5, rtol=2e-5)
+
+
+def test_flash_padded_grad_matches_reference():
+    # Causal self-attention with T not divisible by the blocks takes the
+    # zero-pad path (not the reference fallback); grads must stay exact
+    # including the pad-slice boundary.
+    q, k, v = rand_qkv(b=1, h=2, t=40, d=8)
+    w = jax.random.normal(jax.random.PRNGKey(3), (1, 2, 40, 8))
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_q=16,
+                                block_k=16, interpret=True) * w).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) * w).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_sharded_matches_reference():
+    # The shard_map wrapper (batch on dp, heads on tp) must agree with the
+    # unsharded reference on an 8-device mesh.
+    from tony_tpu.ops import flash_attention_sharded
+    from tony_tpu.parallel import make_mesh
+
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    q, k, v = rand_qkv(b=4, h=8, t=32, d=8)
+    out = jax.jit(
+        lambda q, k, v: flash_attention_sharded(q, k, v, mesh))(q, k, v)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_cpu_dispatch_uses_reference():

@@ -125,7 +125,19 @@ class Attention(nn.Module):
             assert cfg.mesh is not None, "attention='ring' needs cfg.mesh"
             out = ring_attention_sharded(q, k, v, cfg.mesh, causal=True)
         elif cfg.attention == "flash":
-            out = flash_attention(q, k, v, causal=True)
+            if cfg.mesh is not None and cfg.mesh.shape.get("seq", 1) > 1:
+                # A sharded sequence axis means per-device flash would be
+                # wrong (causal attention needs global K/V) — ring
+                # attention owns that layout.
+                from tony_tpu.parallel import ring_attention_sharded
+                out = ring_attention_sharded(q, k, v, cfg.mesh, causal=True)
+            elif cfg.mesh is not None:
+                # GSPMD can't partition a pallas call from annotations
+                # alone — explicitly map it (heads on the tp axis).
+                from tony_tpu.ops import flash_attention_sharded
+                out = flash_attention_sharded(q, k, v, cfg.mesh, causal=True)
+            else:
+                out = flash_attention(q, k, v, causal=True)
         else:
             out = reference_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd)

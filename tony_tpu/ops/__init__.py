@@ -8,6 +8,8 @@ fuses elementwise chains and layernorms well). Every op ships with a pure-JAX
 reference implementation used for CPU tests and as the autodiff backward.
 """
 
-from tony_tpu.ops.attention import flash_attention, reference_attention
+from tony_tpu.ops.attention import (
+    flash_attention, flash_attention_sharded, reference_attention)
 
-__all__ = ["flash_attention", "reference_attention"]
+__all__ = ["flash_attention", "flash_attention_sharded",
+           "reference_attention"]

@@ -27,6 +27,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# The per-row log-sum-exp is carried as [rows, _LSE_LANES] with the value
+# replicated across lanes: a (block_q,) 1-D block has its second-to-minor
+# dim squeezed, which the Mosaic TPU lowering rejects — blocks need a
+# (sublane, lane) shape whose dims divide the (8, 128) f32 tile or equal
+# the array dims. Lane-replicating is the same layout the reference JAX
+# TPU flash kernel uses for its l/m residuals.
+_LSE_LANES = 8
+
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
@@ -93,7 +101,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, a0))
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe)).reshape(bq)
+    lse_ref[:] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, _LSE_LANES))
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
@@ -106,7 +114,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
     o = o_ref[:].astype(jnp.float32)
-    lse = lse_ref[:].reshape(bq, 1)
+    lse = lse_ref[:, 0:1]                                # [Bq, 1]
     D = jnp.sum(do * o, axis=-1, keepdims=True)          # [Bq, 1]
     num_kb = pl.cdiv((qi + 1) * bq, block_k) if causal else pl.cdiv(
         t, block_k)
@@ -150,7 +158,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         o = o_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+        lse = lse_ref[pl.ds(qb * block_q, block_q), 0:1]  # [Bq, 1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -195,11 +203,11 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((None, block_q, _LSE_LANES), lambda bh, i: (bh, i, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, _LSE_LANES), jnp.float32),
         ),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
@@ -207,7 +215,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             bytes_accessed=(qr.size + kr.size + vr.size) * q.dtype.itemsize,
             transcendentals=b * h * t * tk),
     )(qr, kr, vr)
-    return out.reshape(b, h, t, d), lse.reshape(b, h, t)
+    return out.reshape(b, h, t, d), lse   # lse: [b·h, t, _LSE_LANES]
 
 
 def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
@@ -217,12 +225,12 @@ def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
     bh = b * h
     qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
     dor, outr = do.reshape(bh, t, d), o.reshape(bh, t, d)
-    lser = lse.reshape(bh, t)
+    lser = lse                                    # [bh, t, _LSE_LANES]
     q_spec = pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0))
     kv_full = pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0))
     q_full = pl.BlockSpec((None, t, d), lambda g, i: (g, 0, 0))
-    lse_blk = pl.BlockSpec((None, block_q), lambda g, i: (g, i))
-    lse_full = pl.BlockSpec((None, t), lambda g, i: (g, 0))
+    lse_blk = pl.BlockSpec((None, block_q, _LSE_LANES), lambda g, i: (g, i, 0))
+    lse_full = pl.BlockSpec((None, t, _LSE_LANES), lambda g, i: (g, 0, 0))
     k_spec = pl.BlockSpec((None, block_k, d), lambda g, j: (g, j, 0))
 
     dq = pl.pallas_call(
@@ -270,6 +278,23 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _warn_fallback(reason: str) -> None:
+    """One warning per distinct reason when a TPU run leaves the kernel
+    path — the reference fallback materializes the T×T score matrix, an
+    OOM/perf cliff on long sequences that should never be silent."""
+    import warnings
+
+    if reason not in _warned:
+        _warned.add(reason)
+        warnings.warn(
+            f"flash_attention: falling back to reference attention "
+            f"({reason}); the full score matrix will materialize",
+            stacklevel=3)
+
+
+_warned: set = set()
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
@@ -278,8 +303,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Dispatch: the pallas kernel on TPU backends (or when ``interpret=True``
     forces the pallas interpreter — how CPU tests cover the kernel), the
-    pure-JAX reference otherwise. Sequence length must divide by the block
-    sizes on the kernel path; callers pad or fall back.
+    pure-JAX reference elsewhere. Causal self-attention with a sequence
+    length that doesn't divide the block size is zero-padded up to the next
+    block boundary (end-padded keys sit above the diagonal for every real
+    query, so the causal mask already excludes them); other ragged cases
+    fall back to the reference with a one-time warning.
     """
     d = q.shape[-1]
     scale = d ** -0.5 if scale is None else scale
@@ -289,8 +317,65 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if not on_tpu:
             return reference_attention(q, k, v, causal, scale)
         interpret = False
-    block_q = min(block_q, t)
-    block_k = min(block_k, tk)
-    if t % block_q or tk % block_k:
+    # Blocks must divide the seq dims AND be sublane-tile-legal: the
+    # in-kernel pl.ds(kb*block, block) K/V slices need block to be a
+    # multiple of the sublane tile (8 for f32, 16 for bf16 — 16 covers
+    # both), else Mosaic rejects the unaligned slice even when the block
+    # equals the array dim.
+    bq, bk = min(block_q, t), min(block_k, tk)
+    if t % bq == 0 and tk % bk == 0 and bq % 16 == 0 and bk % 16 == 0:
+        return _flash(q, k, v, causal, scale, bq, bk, interpret)
+    if not (causal and t == tk):
+        _warn_fallback(
+            f"seq lengths ({t}, {tk}) not divisible by tile-legal blocks "
+            f"({bq}, {bk}) and not causal self-attention")
         return reference_attention(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    # Zero-pad the seq dim to a tile-legal multiple of the caller's blocks.
+    import math
+    bq = max(16, block_q - block_q % 16)
+    bk = max(16, block_k - block_k % 16)
+    t_pad = t + ((-t) % math.lcm(bq, bk))
+    bq, bk = min(bq, t_pad), min(bk, t_pad)
+    widths = ((0, 0), (0, 0), (0, t_pad - t), (0, 0))
+    qp, kp, vp = (jnp.pad(x, widths) for x in (q, k, v))
+    out = _flash(qp, kp, vp, causal, scale, bq, bk, interpret)
+    return out[:, :, :t, :]
+
+
+def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                            mesh, causal: bool = True,
+                            scale: Optional[float] = None,
+                            block_q: int = 128, block_k: int = 128,
+                            model_axis: str = "model") -> jax.Array:
+    """Global-array entry point: shard_map the flash kernel over the mesh —
+    batch over the data axes, heads over the tensor-parallel axis, sequence
+    unsharded (intra-chip fusion is this kernel's job; a sharded sequence
+    axis is :func:`tony_tpu.parallel.ring_attention_sharded`'s).
+
+    GSPMD cannot partition a custom pallas call from sharding annotations
+    alone — an unmapped kernel inside a tp>1 jit gets its operands
+    all-gathered per device, defeating tensor parallelism — so models must
+    route through this wrapper whenever a mesh is active.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    b, h = q.shape[0], q.shape[1]
+    dp_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    dp_size = 1
+    for a in dp_axes:
+        dp_size *= mesh.shape[a]
+    tp = model_axis if model_axis in mesh.axis_names else None
+    tp_size = mesh.shape[tp] if tp else 1
+    if b % dp_size or h % tp_size:
+        # shard_map needs exact divisibility; rather than hard-fail a
+        # config the plain GSPMD path would run (slowly), fall back.
+        _warn_fallback(
+            f"batch {b} % dp {dp_size} or heads {h} % tp {tp_size} != 0; "
+            f"flash kernel will run unmapped under GSPMD")
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+    spec = P(dp_axes or None, tp, None, None)
+    fn = functools.partial(flash_attention, causal=causal, scale=scale,
+                           block_q=block_q, block_k=block_k)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
