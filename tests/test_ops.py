@@ -116,7 +116,8 @@ def test_flash_sharded_matches_reference():
     mesh = make_mesh(dp=2, sp=2, tp=2)
     q, k, v = rand_qkv(b=4, h=8, t=32, d=8)
     out = jax.jit(
-        lambda q, k, v: flash_attention_sharded(q, k, v, mesh))(q, k, v)
+        lambda q, k, v: flash_attention_sharded(
+            q, k, v, mesh, block_q=16, block_k=16, interpret=True))(q, k, v)
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)

@@ -58,8 +58,12 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
+        # bf16 compute dtype: activations stay 2-byte through the norm
+        # (f32 norms would bounce every activation bf16->f32->bf16, doubling
+        # HBM traffic on a bandwidth-bound model); running stats and
+        # scale/bias params remain f32 via param_dtype.
         norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32)
         x = x.astype(self.dtype)
         x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],

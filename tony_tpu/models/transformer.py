@@ -215,12 +215,15 @@ class Transformer(nn.Module):
             for i in range(cfg.n_layers):
                 x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        # lm_head matmul in bf16 (an f32 matmul runs at a fraction of MXU
+        # bf16 peak and this is ~2·dim·vocab FLOPs/token); logits cast to
+        # f32 afterwards for a stable softmax in the loss.
         logits = nn.DenseGeneral(
-            cfg.vocab, axis=-1, use_bias=False, dtype=jnp.float32,
+            cfg.vocab, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=jnp.float32, name="lm_head",
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "vocab")))(x)
-        return logits
+        return logits.astype(jnp.float32)
 
 
 @register("llama2-7b")

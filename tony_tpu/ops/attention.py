@@ -330,12 +330,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"seq lengths ({t}, {tk}) not divisible by tile-legal blocks "
             f"({bq}, {bk}) and not causal self-attention")
         return reference_attention(q, k, v, causal, scale)
-    # Zero-pad the seq dim to a tile-legal multiple of the caller's blocks.
+    # Zero-pad the seq dim to a tile-legal multiple of the caller's blocks,
+    # re-bounding blocks by the padded length so short sequences don't pay
+    # for a full default-sized block (t=8 pads to 16, not 128).
     import math
-    bq = max(16, block_q - block_q % 16)
-    bk = max(16, block_k - block_k % 16)
+    t16 = t + ((-t) % 16)
+    bq = min(max(16, block_q - block_q % 16), t16)
+    bk = min(max(16, block_k - block_k % 16), t16)
     t_pad = t + ((-t) % math.lcm(bq, bk))
-    bq, bk = min(bq, t_pad), min(bk, t_pad)
     widths = ((0, 0), (0, 0), (0, t_pad - t), (0, 0))
     qp, kp, vp = (jnp.pad(x, widths) for x in (q, k, v))
     out = _flash(qp, kp, vp, causal, scale, bq, bk, interpret)
@@ -346,7 +348,8 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                             mesh, causal: bool = True,
                             scale: Optional[float] = None,
                             block_q: int = 128, block_k: int = 128,
-                            model_axis: str = "model") -> jax.Array:
+                            model_axis: str = "model",
+                            interpret: Optional[bool] = None) -> jax.Array:
     """Global-array entry point: shard_map the flash kernel over the mesh —
     batch over the data axes, heads over the tensor-parallel axis, sequence
     unsharded (intra-chip fusion is this kernel's job; a sharded sequence
@@ -373,9 +376,11 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
             f"batch {b} % dp {dp_size} or heads {h} % tp {tp_size} != 0; "
             f"flash kernel will run unmapped under GSPMD")
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
     spec = P(dp_axes or None, tp, None, None)
     fn = functools.partial(flash_attention, causal=causal, scale=scale,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
