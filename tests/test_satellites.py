@@ -158,10 +158,41 @@ def test_tpuvm_scheduler_remote_command():
     launch = ContainerLaunch(job_type="worker", index=0,
                              env={"TONY_JOB_NAME": "worker",
                                   "TONY_AM_ADDRESS": "10.0.0.9:1234"})
-    argv = sched.build_remote_command(launch, "10.0.0.1")
+    argv = sched.build_remote_command(launch, "10.0.0.1", cid="c01")
     assert argv[0] == "ssh" and argv[1] == "10.0.0.1"
     remote = argv[2]
     assert "mkdir -p /tmp/tt" in remote
     assert "export TONY_AM_ADDRESS=10.0.0.9:1234;" in remote
     assert "export TONY_EXECUTOR_HOST=10.0.0.1;" in remote
-    assert remote.endswith("python3 -m tony_tpu.executor")
+    # Remote lifecycle contract: setsid + pidfile so a second ssh exec can
+    # kill the remote process group; wait propagates the exit code.
+    assert "setsid python3 -m tony_tpu.executor" in remote
+    assert "pids/c01.pid" in remote
+    assert "wait $pid" in remote
+
+
+def test_tpuvm_chip_accounting_and_venv_rewrite(tmp_path):
+    sched = TpuVmScheduler(hosts=["a", "b"], remote_workdir="/tmp/tt",
+                           host_tpus=4)
+    # 4-chip asks land on distinct hosts; a third cannot fit anywhere.
+    l4 = ContainerLaunch(job_type="worker", index=0, env={}, tpus=4)
+    h1 = sched._host_for(l4)
+    h2 = sched._host_for(l4)
+    assert {h1, h2} == {"a", "b"}
+    import pytest
+    with pytest.raises(RuntimeError, match="unsatisfiable"):
+        sched._host_for(l4)
+    with pytest.raises(RuntimeError, match="unsatisfiable"):
+        sched._host_for(ContainerLaunch(
+            job_type="worker", index=9, env={}, tpus=8))
+    # Venv paths rewrite to the staged worker-side copy (dir vs archive).
+    venv_dir = tmp_path / "venv"
+    venv_dir.mkdir()
+    argv = sched.build_remote_command(ContainerLaunch(
+        job_type="w", index=0, env={"TONY_VENV": str(venv_dir)}), "a")
+    assert "export TONY_VENV=/tmp/tt/venv-stage;" in argv[2]
+    venv_zip = tmp_path / "venv.tar.gz"
+    venv_zip.write_bytes(b"x")
+    argv = sched.build_remote_command(ContainerLaunch(
+        job_type="w", index=0, env={"TONY_VENV": str(venv_zip)}), "a")
+    assert "export TONY_VENV=/tmp/tt/venv-stage/venv.tar.gz;" in argv[2]

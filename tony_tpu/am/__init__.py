@@ -147,6 +147,20 @@ class ApplicationMaster:
         self._containers[task.task_id] = container
         self._log(f"launched {task.task_id} in {container.container_id}")
 
+    def _try_launch(self, session: TonySession, job_type: str,
+                    index: int) -> None:
+        """Launch, converting substrate failures (unsatisfiable resource
+        ask, staging error on the ssh substrate) into a task failure the
+        success policy sees — not an AM crash (reference: the RM rejecting
+        an ask surfaces as a failed container, never kills the AM)."""
+        try:
+            self._launch_task(session, job_type, index)
+        except Exception as e:  # noqa: BLE001 — substrate errors vary
+            self._log(f"launch of {job_type}:{index} failed: {e}")
+            session.on_task_result(
+                job_type, index, constants.EXIT_AM_ERROR,
+                f"container launch failed: {e}")
+
     def _stop_task_containers(self, session: TonySession) -> None:
         for task in session.tasks():
             c = self._containers.get(task.task_id)
@@ -202,7 +216,7 @@ class ApplicationMaster:
                     with session.lock:
                         task.host = task.port = None
                         task.status = TaskStatus.REQUESTED
-                    self._launch_task(session, task.job_type, task.index)
+                    self._try_launch(session, task.job_type, task.index)
                 else:
                     session.on_task_result(
                         task.job_type, task.index, constants.EXIT_PREEMPTED,
@@ -261,7 +275,7 @@ class ApplicationMaster:
                 still_pending = []
                 for jt, i in pending:
                     if am_adapter.can_start_task(jt, i):
-                        self._launch_task(session, jt, i)
+                        self._try_launch(session, jt, i)
                     else:
                         still_pending.append((jt, i))
                 pending = still_pending

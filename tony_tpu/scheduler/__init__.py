@@ -87,8 +87,17 @@ class ContainerScheduler:
         ``PREEMPTED``). Returns False if the container is not running."""
         raise NotImplementedError
 
-    def stop(self) -> None:
-        """Tear down everything still running."""
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Tear down everything still running, then drain completions."""
+        for c in self._live_containers():
+            self.stop_container(c)
+        deadline = time.monotonic() + drain_s
+        while self._live_containers() and time.monotonic() < deadline:
+            self.poll_completed()
+            time.sleep(0.05)
+
+    def _live_containers(self) -> List["Container"]:
+        raise NotImplementedError
 
 
 class LocalProcessScheduler(ContainerScheduler):
@@ -189,13 +198,7 @@ class LocalProcessScheduler(ContainerScheduler):
         with self._lock:
             return list(self._running.values())
 
-    def stop(self) -> None:
-        for c in self.running():
-            self.stop_container(c)
-        deadline = time.monotonic() + 5
-        while self.running() and time.monotonic() < deadline:
-            self.poll_completed()
-            time.sleep(0.05)
+    _live_containers = running
 
 
 def scheduler_from_conf(conf, job_dir: str | Path,
@@ -220,7 +223,10 @@ def scheduler_from_conf(conf, job_dir: str | Path,
             ssh_cmd=conf.get("tony.scheduler.ssh-command", "ssh"),
             remote_python=conf.get("tony.scheduler.remote-python", "python3"),
             remote_workdir=conf.get("tony.scheduler.remote-workdir",
-                                    "/tmp/tony-tpu"))
+                                    "/tmp/tony-tpu"),
+            remote_pythonpath=conf.get("tony.scheduler.remote-pythonpath")
+            or None,
+            host_tpus=conf.get_int("tony.scheduler.host-tpus", 0))
     if backend != "local":
         raise ValueError(f"unknown tony.scheduler.backend={backend!r}")
     return None  # caller builds LocalProcessScheduler with its own args
@@ -246,18 +252,31 @@ def docker_wrap_command(conf, argv: List[str]) -> List[str]:
 class TpuVmScheduler(ContainerScheduler):
     """Multi-host pod-slice backend: one executor per TPU-VM worker via SSH.
 
-    Interface-complete but deliberately thin: this environment has a single
-    chip and no pod, so remote launches cannot be exercised here. The
-    contract mirrors ``gcloud compute tpus tpu-vm ssh --worker=N --command``
-    fan-out: ``hosts`` lists worker addresses; each launch is pinned
-    round-robin (task global order) to a host, and the executor env rides the
-    SSH command line. Completion is detected by the remote shell exiting.
+    The contract mirrors ``gcloud compute tpus tpu-vm ssh --worker=N
+    --command`` fan-out: ``hosts`` lists worker addresses; the executor env
+    rides the SSH command line; completion is detected by the remote shell
+    exiting with the executor's code.
+
+    Remote lifecycle: each launch runs the executor under ``setsid`` with
+    its pid written to ``pids/<cid>.pid`` on the worker, so kill/preempt can
+    reach the *remote process group* (executor + user child) over a second
+    SSH exec — terminating only the local SSH client would orphan them.
+
+    Placement: when ``host_tpus`` is set, each host carries that many chips
+    and tasks are placed least-loaded-first so chip asks never oversubscribe
+    a worker (the ``yarn.io/tpu`` resource-type semantics of the north
+    star); with no chip asks, placement balances running task count.
+
+    Exercised end-to-end by the fake-ssh e2e tier (``tests/test_e2e.py``):
+    ``ssh_cmd`` pointed at a local shim script runs the full gang/failure/
+    preemption matrix against this substrate without a pod.
     """
 
     def __init__(self, hosts: List[str], ssh_cmd: str = "ssh",
                  remote_python: str = "python3",
                  remote_workdir: str = "/tmp/tony-tpu",
-                 remote_pythonpath: Optional[str] = None):
+                 remote_pythonpath: Optional[str] = None,
+                 host_tpus: int = 0):
         if not hosts:
             raise ValueError("TpuVmScheduler requires at least one host")
         self.hosts = list(hosts)
@@ -265,10 +284,19 @@ class TpuVmScheduler(ContainerScheduler):
         self.remote_python = remote_python
         self.remote_workdir = remote_workdir
         self.remote_pythonpath = remote_pythonpath  # None = pip-installed
+        self.host_tpus = host_tpus                  # chips per worker; 0 = off
+        self._host_chips: Dict[str, int] = {h: 0 for h in self.hosts}
+        self._host_tasks: Dict[str, int] = {h: 0 for h in self.hosts}
         self._running: Dict[str, Container] = {}
         self._lock = threading.Lock()
+        self._stage_lock = threading.Lock()
         self._next_id = 0
         self._staged_hosts: set = set()
+
+    def _ssh_argv(self, host: str, remote_sh: str) -> List[str]:
+        """argv for one remote exec; ``ssh_cmd`` may carry flags
+        (``ssh -i key``) or be a local shim script (tests)."""
+        return shlex.split(self.ssh_cmd) + [host, remote_sh]
 
     def build_stage_command(self, local_dir: str, host: str,
                             remote_subdir: str, items: str = ".") -> str:
@@ -280,64 +308,128 @@ class TpuVmScheduler(ContainerScheduler):
                 f"{self.ssh_cmd} {host} "
                 f"{shlex.quote(f'mkdir -p {dest} && tar -xf - -C {dest}')}")
 
-    def build_remote_command(self, launch: ContainerLaunch,
-                             host: str) -> List[str]:
+    def build_remote_command(self, launch: ContainerLaunch, host: str,
+                             cid: str = "adhoc") -> List[str]:
         """The SSH argv for one executor launch (separated for testability:
         command construction is covered by unit tests, the network is not).
-        Paths in the env that point at client-side staging (conf, src) are
-        rewritten to the worker-side copies laid down by
+        Paths in the env that point at client-side staging (conf, src,
+        venv) are rewritten to the worker-side copies laid down by
         :meth:`build_stage_command`."""
         env = {**launch.env, "TONY_EXECUTOR_HOST": host}
+        wd = self.remote_workdir
         if constants.ENV_CONF_PATH in env:
             env[constants.ENV_CONF_PATH] = (
-                f"{self.remote_workdir}/conf/{constants.TONY_JOB_JSON}")
+                f"{wd}/conf/{constants.TONY_JOB_JSON}")
         if constants.ENV_SRC_DIR in env:
-            env[constants.ENV_SRC_DIR] = f"{self.remote_workdir}/src"
+            env[constants.ENV_SRC_DIR] = f"{wd}/src"
+        venv = env.get(constants.ENV_VENV)
+        if venv:
+            # Archives stage as the file itself; dirs stage as contents.
+            if Path(venv).is_file():
+                env[constants.ENV_VENV] = (
+                    f"{wd}/venv-stage/{Path(venv).name}")
+            else:
+                env[constants.ENV_VENV] = f"{wd}/venv-stage"
         if self.remote_pythonpath:
             env["PYTHONPATH"] = self.remote_pythonpath
         exports = " ".join(
             f"export {k}={shlex.quote(v)};" for k, v in sorted(env.items()))
-        remote = (f"mkdir -p {self.remote_workdir} && cd {self.remote_workdir} "
-                  f"&& {exports} {self.remote_python} -m tony_tpu.executor")
-        return [self.ssh_cmd, host, remote]
+        # setsid: the executor becomes leader of a fresh process group whose
+        # pgid == its pid, so `kill -- -$(cat pidfile)` reaps it AND the
+        # user process it spawned; `wait` propagates the executor's exit
+        # code (or 128+SIG after a remote kill) back through ssh.
+        remote = (
+            f"mkdir -p {wd}/pids && cd {wd} || exit 1; {exports} "
+            f"setsid {self.remote_python} -m tony_tpu.executor "
+            f"< /dev/null & pid=$!; echo $pid > pids/{cid}.pid; "
+            f"wait $pid; rc=$?; rm -f pids/{cid}.pid; exit $rc")
+        return self._ssh_argv(host, remote)
 
     def _host_for(self, launch: ContainerLaunch) -> str:
+        """Least-loaded placement with per-host chip accounting (reference:
+        the RM matching a resource ask to a node with capacity)."""
         with self._lock:
-            host = self.hosts[self._next_id % len(self.hosts)]
+            if launch.tpus and self.host_tpus:
+                if launch.tpus > self.host_tpus:
+                    raise RuntimeError(
+                        f"unsatisfiable tpu ask: task wants {launch.tpus} "
+                        f"chips but hosts have {self.host_tpus}")
+                fits = [h for h in self.hosts
+                        if self._host_chips[h] + launch.tpus <= self.host_tpus]
+                if not fits:
+                    raise RuntimeError(
+                        f"unsatisfiable tpu ask: {launch.tpus} chips "
+                        f"requested, per-host free: "
+                        f"{ {h: self.host_tpus - self._host_chips[h] for h in self.hosts} }")
+                host = min(fits, key=lambda h: (self._host_chips[h],
+                                                self._host_tasks[h]))
+                self._host_chips[host] += launch.tpus
+            else:
+                host = min(self.hosts, key=lambda h: self._host_tasks[h])
+            self._host_tasks[host] += 1
         return host
 
+    def _stage(self, local: str, host: str, subdir: str,
+               items: str = ".") -> None:
+        cmd = self.build_stage_command(local, host, subdir, items=items)
+        proc = subprocess.run(cmd, shell=True, timeout=300,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"staging {local} -> {host}:{self.remote_workdir}/{subdir} "
+                f"failed (rc={proc.returncode}): {proc.stderr[-500:]}")
+
     def _stage_once(self, launch: ContainerLaunch, host: str) -> None:
-        """Stage conf + src onto the worker the first time it's used."""
-        with self._lock:
+        """Stage conf + src + venv onto the worker the first time it's
+        used. The host is marked staged only after every transfer succeeds;
+        a failure raises so the launch (and the job) fails loudly instead
+        of executors dying later on a missing-conf error."""
+        with self._stage_lock:
             if host in self._staged_hosts:
                 return
+            conf_path = launch.env.get(constants.ENV_CONF_PATH)
+            if conf_path and Path(conf_path).is_file():
+                self._stage(str(Path(conf_path).parent), host, "conf",
+                            items=Path(conf_path).name)
+            src_dir = launch.env.get(constants.ENV_SRC_DIR)
+            if src_dir and Path(src_dir).is_dir():
+                self._stage(src_dir, host, "src")
+            venv = launch.env.get(constants.ENV_VENV)
+            if venv and Path(venv).is_file():
+                self._stage(str(Path(venv).parent), host, "venv-stage",
+                            items=Path(venv).name)
+            elif venv and Path(venv).is_dir():
+                self._stage(venv, host, "venv-stage")
             self._staged_hosts.add(host)
-        conf_path = launch.env.get(constants.ENV_CONF_PATH)
-        if conf_path and Path(conf_path).is_file():
-            subprocess.run(
-                self.build_stage_command(str(Path(conf_path).parent), host,
-                                         "conf", items=Path(conf_path).name),
-                shell=True, check=False, timeout=300)
-        src_dir = launch.env.get(constants.ENV_SRC_DIR)
-        if src_dir and Path(src_dir).is_dir():
-            subprocess.run(self.build_stage_command(src_dir, host, "src"),
-                           shell=True, check=False, timeout=300)
 
     def launch(self, launch: ContainerLaunch) -> Container:
         host = self._host_for(launch)
         with self._lock:
             self._next_id += 1
             cid = f"container_tpuvm_{self._next_id:04d}"
-        self._stage_once(launch, host)
-        proc = subprocess.Popen(
-            self.build_remote_command(launch, host),
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True)
+        try:
+            self._stage_once(launch, host)
+            proc = subprocess.Popen(
+                self.build_remote_command(launch, host, cid=cid),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True)
+        except Exception:
+            # Release the accounting or gang-restart retries would see the
+            # chips as permanently occupied (the scheduler outlives attempts).
+            self._release_host(host, launch.tpus)
+            raise
         c = Container(container_id=cid, job_type=launch.job_type,
                       index=launch.index, host=host, _proc=proc)
+        c._tpus = launch.tpus  # type: ignore[attr-defined]
         with self._lock:
             self._running[cid] = c
         return c
+
+    def _release_host(self, host: str, tpus: int) -> None:
+        with self._lock:
+            if self.host_tpus and tpus:
+                self._host_chips[host] -= tpus
+            self._host_tasks[host] -= 1
 
     def poll_completed(self) -> List[Container]:
         done = []
@@ -347,15 +439,43 @@ class TpuVmScheduler(ContainerScheduler):
                 if rc is not None:
                     c.exit_code = (constants.EXIT_PREEMPTED if c.preempted
                                    else rc)
+                    if self.host_tpus and getattr(c, "_tpus", 0):
+                        self._host_chips[c.host] -= c._tpus
+                    self._host_tasks[c.host] -= 1
                     del self._running[cid]
                     done.append(c)
         return done
+
+    def _remote_kill(self, c: Container, sig: str = "KILL") -> bool:
+        """Kill the remote executor's whole process group via its pidfile
+        (second ssh exec). Returns True when the remote kill ran."""
+        pidfile = f"{self.remote_workdir}/pids/{c.container_id}.pid"
+        # `kill -s SIG -- -pgid`: the only group-kill spelling both dash
+        # and bash builtins accept (`kill -SIG -- -pgid` is rejected by
+        # dash, the default /bin/sh on debian-family TPU-VM images). The
+        # pidfile is removed here, not only by the launch shell's cleanup —
+        # the local ssh client may be torn down before that cleanup runs.
+        sh = (f"[ -f {pidfile} ] && pid=$(cat {pidfile}) && "
+              f"rm -f {pidfile} && kill -s {sig} -- -$pid 2>/dev/null")
+        try:
+            proc = subprocess.run(self._ssh_argv(c.host, sh), timeout=30,
+                                  stdout=subprocess.DEVNULL,
+                                  stderr=subprocess.DEVNULL)
+            return proc.returncode == 0
+        except (subprocess.TimeoutExpired, OSError):
+            return False
 
     def stop_container(self, container: Container) -> None:
         with self._lock:
             c = self._running.get(container.container_id)
         if c is not None and c._proc is not None and c._proc.poll() is None:
-            c._proc.terminate()
+            if not self._remote_kill(c):
+                # Remote side unreachable (or already gone): at least drop
+                # the local ssh client so the AM's teardown completes.
+                try:
+                    c._proc.terminate()
+                except OSError:
+                    pass
 
     def preempt(self, container_id: str) -> bool:
         with self._lock:
@@ -363,9 +483,13 @@ class TpuVmScheduler(ContainerScheduler):
         if c is None or c._proc is None or c._proc.poll() is not None:
             return False
         c.preempted = True
-        c._proc.kill()
+        if not self._remote_kill(c):
+            c._proc.kill()
         return True
 
-    def stop(self) -> None:
-        for c in list(self._running.values()):
-            self.stop_container(c)
+    def _live_containers(self) -> List[Container]:
+        with self._lock:
+            return list(self._running.values())
+
+    def stop(self, drain_s: float = 10.0) -> None:
+        super().stop(drain_s)
