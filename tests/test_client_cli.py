@@ -229,3 +229,15 @@ def test_proxy_roundtrip():
         assert c.recv(1024) == b"echo:hello"
         c.close()
     srv.close()
+
+
+def test_client_reports_submit_to_running_latency(tmp_path):
+    """BASELINE.md secondary metric: the client prints submit→all-RUNNING
+    and keeps the number (shipped to the AM via TONY_SUBMIT_TS)."""
+    out = io.StringIO()
+    client = run_client(tmp_path, stream=out, **{
+        "tony.application.executes": "python sleep_exit_0.py"})
+    assert client.exit_code == 0
+    assert client.all_running_latency_s is not None
+    assert 0 < client.all_running_latency_s < 60
+    assert "all tasks running" in out.getvalue()

@@ -482,3 +482,62 @@ def test_tpuvm_staging_failure_fails_job_not_am(tpuvm):
     assert job.session.job_status is JobStatus.FAILED
     diags = " ".join(t.diagnostics or "" for t in job.session.tasks())
     assert "staging" in diags and "failed" in diags
+
+
+def test_metrics_timeline_and_latency_events(pod, monkeypatch):
+    """VERDICT r2 #5/#8: TaskMonitor samples must survive as a TASK_METRICS
+    timeline in the jhist (not just the final snapshot), and the gang
+    barrier must record the submit→all-RUNNING latency."""
+    import time as _time
+
+    from tony_tpu import events as ev
+    from tony_tpu.history import job_detail, _job_page
+
+    monkeypatch.setenv(constants.ENV_SUBMIT_TS, repr(_time.time()))
+    job = pod.run(props(**{
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("sleep_exit_0.py"),
+        "tony.task.metrics-interval-ms": "150",
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    # In-session timeline: multiple bounded samples, monotone timestamps.
+    t = job.session.task("worker", 0)
+    assert len(t.metrics_history) >= 2
+    assert t.metrics_history == sorted(t.metrics_history,
+                                       key=lambda s: s["ts"])
+    assert job.session.all_running_latency_s is not None
+    assert 0 < job.session.all_running_latency_s < 60
+    # jhist timeline + latency event.
+    [jhist] = (Path(job.am.job_dir) / "history" / "finished").glob("*.jhist")
+    records = ev.read_events(jhist)
+    samples = [r for r in records if r["type"] == ev.TASK_METRICS]
+    assert len(samples) >= 2
+    assert all(r["payload"]["job_type"] == "worker" for r in samples)
+    assert "rss_mb" in samples[0]["payload"]["metrics"] or \
+        samples[0]["payload"]["metrics"]  # at least one metric key
+    [running] = [r for r in records if r["type"] == ev.ALL_TASKS_RUNNING]
+    assert running["payload"]["submit_to_running_s"] > 0
+    # Portal render: the job page shows the per-task history, not one row.
+    detail = job_detail({"app_id": job.am.app_id, "state": "finished",
+                         "path": str(jhist), "metadata": {}})
+    assert len(detail["metrics_timelines"]["worker:0"]) >= 2
+    page = _job_page(detail)
+    assert "Metrics timeline" in page and "samples" in page
+    assert "submit→all-running" in page
+
+
+def test_callback_info_dispatched_to_am(pod):
+    """VERDICT r2 #7: registerCallbackInfo must reach the AM (dead SPI in
+    r2). The JAX runtime's consumer: executors push their bound profiler
+    endpoint."""
+    job = pod.run(props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("sleep_exit_0.py"),
+        "tony.task.profiler.enabled": "true",
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    info = job.session.task_callback_info
+    assert "worker:0" in info
+    payload = json.loads(info["worker:0"])
+    assert payload["profiler"].endswith(":9431")  # port-base + rank 0

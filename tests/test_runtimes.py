@@ -223,3 +223,15 @@ def test_horovod_validate_idempotent():
         assert am.driver is first
     finally:
         am.stop()
+
+
+def test_jax_am_adapter_collects_profiler_callbacks():
+    from tony_tpu.runtime.jax_runtime import JAXAMAdapter
+
+    a = JAXAMAdapter()
+    a.receive_task_callback_info("worker:1", '{"profiler": "h1:9432"}')
+    a.receive_task_callback_info("worker:0", '{"profiler": "h0:9431"}')
+    a.receive_task_callback_info("worker:2", "not json")     # ignored
+    a.receive_task_callback_info("worker:3", '{"other": 1}')  # ignored
+    assert a.profiler_endpoints == {"worker:0": "h0:9431",
+                                    "worker:1": "h1:9432"}

@@ -21,6 +21,7 @@ over, re-mapped from YARN to the :mod:`tony_tpu.scheduler` substrate:
 from __future__ import annotations
 
 import json
+import os
 import secrets
 import sys
 import time
@@ -246,13 +247,29 @@ class ApplicationMaster:
         def on_all_registered() -> None:
             am_adapter.on_all_registered()
             handler.callback_info.update(am_adapter.callback_info())
-            self._log("gang barrier passed: all tasks registered")
+            # submit → all-RUNNING latency (BASELINE.md secondary metric):
+            # the client ships its submit wall-clock in TONY_SUBMIT_TS.
+            latency = None
+            submit_ts = os.environ.get(constants.ENV_SUBMIT_TS)
+            if submit_ts:
+                try:
+                    latency = time.time() - float(submit_ts)
+                except ValueError:
+                    pass
+            session.all_running_latency_s = latency
+            self._log("gang barrier passed: all tasks registered"
+                      + (f" ({latency:.2f}s after submit)" if latency else ""))
+            if self.events is not None:
+                self.events.all_running(session.attempt_id, latency)
 
         handler.on_all_registered = on_all_registered
+        handler.on_callback_info = am_adapter.receive_task_callback_info
         if self.events is not None:
             handler.on_registered = (
                 lambda jt, i: self.events.task_started(
                     jt, i, session.task(jt, i).host or ""))
+            handler.on_metrics = (
+                lambda jt, i, m: self.events.task_metrics(jt, i, m))
         if self.server is None:
             self.server = RpcServer(handler, host="0.0.0.0",
                                     token=self.token).start()

@@ -75,6 +75,8 @@ class TonyClient:
         self.final_status: Optional[str] = None
         self.final_message = ""
         self.tensorboard_url: Optional[str] = None
+        self.submit_time: Optional[float] = None
+        self.all_running_latency_s: Optional[float] = None
         self._listeners: List[Callable[[List[Dict]], None]] = []
         self._last_status: Dict[str, str] = {}
 
@@ -131,6 +133,9 @@ class TonyClient:
         am_log = open(self.job_dir / "am.log", "ab")
         env = dict(os.environ)
         env["PYTHONPATH"] = child_pythonpath(env)
+        # Submit timestamp for the AM's submit→all-RUNNING latency metric.
+        self.submit_time = time.time()
+        env[constants.ENV_SUBMIT_TS] = repr(self.submit_time)
         self.am_proc = subprocess.Popen(
             [sys.executable, "-m", "tony_tpu.am",
              "--conf", str(self.job_dir / "client-conf.json"),
@@ -214,6 +219,11 @@ class TonyClient:
                         if url and url != self.tensorboard_url:
                             self.tensorboard_url = url
                             self._log(f"TensorBoard at {url}")
+                        lat = status.get("all_running_latency_s")
+                        if lat and self.all_running_latency_s is None:
+                            self.all_running_latency_s = float(lat)
+                            self._log(f"all tasks running {lat:.2f}s "
+                                      f"after submit")
                 if deadline and time.monotonic() > deadline:
                     self._log(f"client monitor timed out; killing {self.app_id}")
                     self.kill("client monitor timeout")

@@ -20,6 +20,7 @@ ENV_CONTAINER_ID = "TONY_CONTAINER_ID"      # container id for this executor
 ENV_LOG_DIR = "TONY_LOG_DIR"                # directory for executor+user logs
 ENV_SRC_DIR = "TONY_SRC_DIR"                # localized user source directory
 ENV_VENV = "TONY_VENV"                      # localized virtualenv (optional)
+ENV_SUBMIT_TS = "TONY_SUBMIT_TS"            # client submit wall-clock (epoch s)
 
 # --- Environment contract: TaskExecutor -> user process ---------------------
 # (reference: MLGenericRuntime common env + per-runtime additions)

@@ -31,6 +31,8 @@ from tony_tpu import constants
 
 APPLICATION_INITED = "APPLICATION_INITED"
 TASK_STARTED = "TASK_STARTED"
+TASK_METRICS = "TASK_METRICS"
+ALL_TASKS_RUNNING = "ALL_TASKS_RUNNING"
 TASK_FINISHED = "TASK_FINISHED"
 APPLICATION_FINISHED = "APPLICATION_FINISHED"
 
@@ -85,6 +87,22 @@ class EventHandler:
 
     def task_started(self, job_type: str, index: int, host: str) -> None:
         self.emit(TASK_STARTED, job_type=job_type, index=index, host=host)
+
+    def task_metrics(self, job_type: str, index: int,
+                     metrics: Dict[str, float]) -> None:
+        """One TaskMonitor sample — the per-task metrics *timeline* the
+        portal renders (reference: MetricsRpc history, not just the final
+        snapshot in TASK_FINISHED)."""
+        self.emit(TASK_METRICS, job_type=job_type, index=index,
+                  metrics=dict(metrics))
+
+    def all_running(self, attempt_id: int,
+                    submit_to_running_s: Optional[float] = None) -> None:
+        """Gang barrier passed: every task is RUNNING. Carries the
+        submit→all-RUNNING latency when the client shipped its submit
+        timestamp (BASELINE.md secondary metric)."""
+        self.emit(ALL_TASKS_RUNNING, attempt_id=attempt_id,
+                  submit_to_running_s=submit_to_running_s)
 
     def task_finished(self, job_type: str, index: int, status: str,
                       exit_code: Optional[int], diagnostics: str = "",

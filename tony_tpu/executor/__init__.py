@@ -285,6 +285,20 @@ class TaskExecutor:
                                      url=f"http://{self.host}:{tb_port}")
                 except Exception:
                     pass
+            # Push framework callback info to the AM adapter (reference:
+            # registerCallbackInfo → receiveTaskCallbackInfo): the bound
+            # profiler endpoint, so the AM knows where each rank's
+            # jax.profiler server listens.
+            if constants.ENV_PROFILER_PORT in task_env:
+                try:
+                    self.client.call(
+                        "register_callback_info",
+                        task_id=f"{self.job_type}:{self.index}",
+                        payload=json.dumps({"profiler": (
+                            f"{self.host}:"
+                            f"{task_env[constants.ENV_PROFILER_PORT]}")}))
+                except Exception:
+                    pass
             # 7. metrics monitor.
             metrics_interval_s = conf.get_int(
                 conf_mod.TASK_METRICS_INTERVAL_MS, 5000) / 1e3

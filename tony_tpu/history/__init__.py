@@ -67,12 +67,26 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
                   if r["type"] == ev.APPLICATION_FINISHED), {})
     tasks = [dict(r["payload"], timestamp=r["timestamp"])
              for r in records if r["type"] == ev.TASK_FINISHED]
+    # Per-task metrics timeline from the TASK_METRICS samples (reference:
+    # the portal's per-task metrics pages over the MetricsRpc history).
+    timelines: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if r["type"] == ev.TASK_METRICS:
+            p = r["payload"]
+            tid = f"{p['job_type']}:{p['index']}"
+            timelines.setdefault(tid, []).append(
+                {"timestamp": r["timestamp"], **(p.get("metrics") or {})})
+    all_running = next((r for r in records
+                        if r["type"] == ev.ALL_TASKS_RUNNING), None)
     return {
         "app_id": job["app_id"],
         "state": job["state"],
         "metadata": meta,
         "final": final,
         "tasks": tasks,
+        "metrics_timelines": timelines,
+        "submit_to_running_s": (all_running or {}).get(
+            "payload", {}).get("submit_to_running_s"),
         "events": records,
     }
 
@@ -102,6 +116,9 @@ def render_show(detail: Dict[str, Any]) -> str:
     if final:
         out.append(f"  status: {final.get('status')}"
                    + (f" — {final['message']}" if final.get("message") else ""))
+    if detail.get("submit_to_running_s"):
+        out.append(f"  submit→all-running: "
+                   f"{detail['submit_to_running_s']:.2f}s")
     m = detail["metadata"]
     if m:
         out.append(f"  user: {m.get('user')}  name: {m.get('app_name')}")
@@ -169,7 +186,25 @@ def _job_page(detail: Dict[str, Any]) -> str:
             f"<td>{html.escape(t['status'])}</td>"
             f"<td>{t.get('exit_code')}</td><td>{html.escape(metrics)}</td>"
             f"<td>{html.escape(t.get('diagnostics') or '')}</td></tr>")
-    parts.append("</table><h3>Events</h3><table><tr><th>time</th>"
+    parts.append("</table>")
+    if detail.get("submit_to_running_s"):
+        parts.append(f"<p>submit→all-running: "
+                     f"{detail['submit_to_running_s']:.2f}s</p>")
+    if detail.get("metrics_timelines"):
+        parts.append("<h3>Metrics timeline</h3>")
+        for tid, samples in sorted(detail["metrics_timelines"].items()):
+            parts.append(f"<h4>{html.escape(tid)} "
+                         f"({len(samples)} samples)</h4>"
+                         "<table><tr><th>time</th><th>metrics</th></tr>")
+            for s in samples:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(s["timestamp"]))
+                vals = ", ".join(f"{k}={v}" for k, v in sorted(s.items())
+                                 if k != "timestamp")
+                parts.append(f"<tr><td>{when}</td>"
+                             f"<td>{html.escape(vals)}</td></tr>")
+            parts.append("</table>")
+    parts.append("<h3>Events</h3><table><tr><th>time</th>"
                  "<th>type</th><th>payload</th></tr>")
     for r in detail["events"]:
         when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(r["timestamp"]))

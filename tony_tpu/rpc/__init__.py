@@ -204,6 +204,9 @@ class ApplicationRpcHandler:
         self.on_registered: Optional[Callable[[str, int], None]] = None
         self.on_result: Optional[Callable[[str, int, int, str], None]] = None
         self.on_all_registered: Optional[Callable[[], None]] = None
+        self.on_metrics: Optional[Callable[[str, int, Dict[str, float]],
+                                           None]] = None
+        self.on_callback_info: Optional[Callable[[str, str], None]] = None
         self._all_registered_fired = False
         self._fire_lock = threading.Lock()
 
@@ -260,12 +263,23 @@ class ApplicationRpcHandler:
         return True
 
     def rpc_register_callback_info(self, task_id: str, payload: str) -> bool:
+        """Executor-pushed framework info (reference: registerCallbackInfo
+        feeding Framework.ApplicationMasterAdapter.receiveTaskCallbackInfo).
+        Recorded on the session and dispatched to the AM adapter hook."""
+        self.session.task_callback_info[task_id] = payload
+        if self.on_callback_info:
+            self.on_callback_info(task_id, payload)
         return True
 
     def rpc_metrics_report(self, job_type: str, index: int,
                            metrics: Dict[str, float]) -> bool:
         task = self.session.task(job_type, index)
-        task.metrics.update({str(k): float(v) for k, v in metrics.items()})
+        sample = task.record_metrics(metrics)
+        if self.on_metrics:
+            # The sample, not the cumulative dict: a TASK_METRICS event is
+            # one TaskMonitor reading, and stale keys must not reappear
+            # with fresh timestamps in the portal timeline.
+            self.on_metrics(job_type, index, sample)
         return True
 
     # -- client-facing verbs ----------------------------------------------
@@ -278,6 +292,7 @@ class ApplicationRpcHandler:
             "message": self.session.final_message,
             "attempt_id": self.session.attempt_id,
             "tensorboard_url": self.session.tensorboard_url,
+            "all_running_latency_s": self.session.all_running_latency_s,
         }
 
     def rpc_finish_application(self, reason: str = "killed by client") -> bool:

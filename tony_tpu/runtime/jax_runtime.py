@@ -71,6 +71,24 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
 
 
 class JAXAMAdapter(ApplicationMasterAdapter):
+    def __init__(self) -> None:
+        # Eager init: register_callback_info arrives on concurrent RPC
+        # server threads; lazy hasattr-init could drop a rank's write.
+        self.profiler_endpoints: Dict[str, str] = {}
+
+    def receive_task_callback_info(self, task_id: str, payload: str) -> None:
+        """Collect executor-pushed profiler endpoints (the SPI consumer of
+        registerCallbackInfo): ``profiler_endpoints[task_id] = host:port``
+        of that rank's live ``jax.profiler`` server."""
+        import json
+
+        try:
+            info = json.loads(payload)
+        except ValueError:
+            return
+        if "profiler" in info:
+            self.profiler_endpoints[task_id] = str(info["profiler"])
+
     def validate_and_update_config(self, conf) -> None:
         # JAX jobs are SPMD gangs: parameter-server job types make no sense.
         for jt in conf.job_types():

@@ -69,6 +69,23 @@ class TonyTask:
         self.end_time: float = 0.0
         self.preemption_retries = 0
         self.metrics: Dict[str, float] = {}
+        # Timeline of TaskMonitor samples (reference: the per-task metric
+        # history MetricsRpc accumulates for the portal). Bounded: at the
+        # cap, every other sample is dropped so coverage stays full-span.
+        self.metrics_history: List[Dict[str, float]] = []
+
+    METRICS_HISTORY_CAP = 512
+
+    def record_metrics(self, metrics: Dict[str, float]) -> Dict[str, float]:
+        """Record one TaskMonitor sample; returns the normalized sample."""
+        sample = {str(k): float(v) for k, v in metrics.items()}
+        self.metrics.update(sample)
+        self.metrics_history.append({"ts": time.time(), **sample})
+        if len(self.metrics_history) > self.METRICS_HISTORY_CAP:
+            # Thin odd indices: keeps both the span start and the sample
+            # appended just above.
+            del self.metrics_history[1::2]
+        return sample
 
     @property
     def task_id(self) -> str:
@@ -95,6 +112,7 @@ class TonyTask:
             "exit_code": self.exit_code,
             "diagnostics": self.diagnostics,
             "metrics": dict(self.metrics),
+            "metrics_samples": len(self.metrics_history),
         }
 
     def __repr__(self) -> str:
@@ -117,6 +135,11 @@ class TonySession:
         self.job_status = JobStatus.RUNNING
         self.final_message = ""
         self.tensorboard_url: Optional[str] = None
+        # Executor-pushed framework info by task_id (registerCallbackInfo).
+        self.task_callback_info: Dict[str, str] = {}
+        # submit → all-RUNNING latency, set by the AM when the gang barrier
+        # passes (BASELINE.md secondary metric).
+        self.all_running_latency_s: Optional[float] = None
         self._tasks: Dict[Tuple[str, int], TonyTask] = {}
         untracked = set(conf.untracked_job_types())
         for jt in conf.job_types():
