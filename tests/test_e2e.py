@@ -541,3 +541,25 @@ def test_callback_info_dispatched_to_am(pod):
     assert "worker:0" in info
     payload = json.loads(info["worker:0"])
     assert payload["profiler"].endswith(":9431")  # port-base + rank 0
+
+
+def test_checkpoint_resume_across_gang_restart(pod, tmp_path):
+    """The reference's whole recovery story (SURVEY.md §5.4): attempt 1
+    trains and checkpoints, dies; the gang restarts; attempt 2 restores
+    from the Checkpointer and continues from the saved step."""
+    ckpt_dir = tmp_path / "ckpt"
+    job = pod.run(props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("train_resume.py"),
+        "tony.worker.env": f"CKPT_DIR={ckpt_dir}",
+        "tony.am.retry-count": "1",
+        "tony.task.max-missed-heartbeats": "100",
+    }), src_dir=WORKLOADS, timeout=180)
+    assert job.exit_code == 0, job.session.final_message
+    assert job.session.attempt_id == 2      # attempt 1 failed, 2 resumed
+    results = list(Path(job.am.job_dir).glob("containers/*/src/resume.json"))
+    assert len(results) == 1                # only attempt 2 wrote it
+    data = json.loads(results[0].read_text())
+    assert data["resumed_from"] == 3
+    assert data["final_step"] == 5

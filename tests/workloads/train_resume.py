@@ -1,0 +1,55 @@
+"""Gang-restart resume workload (reference fixture analogue: the user
+script that restores from its HDFS checkpoint dir after an AM restart).
+
+Attempt 1: train 3 steps, save via Checkpointer, exit 1 (induced failure
+-> whole-gang restart). Attempt 2: restore, assert the step survived,
+train 2 more, save, write resume.json, exit 0.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from tony_tpu import train as tr
+from tony_tpu.checkpoint import Checkpointer
+
+ckpt_dir = os.environ["CKPT_DIR"]
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(x)
+
+
+x = jnp.ones((2, 8))
+y = jnp.zeros((2,), jnp.int32)
+state = tr.create_train_state(Tiny(), optax.sgd(0.1), x, jax.random.PRNGKey(0))
+ckpt = Checkpointer(ckpt_dir)
+state = ckpt.restore_or(state)
+start = int(state.step)
+
+step = tr.make_train_step()
+if start == 0:
+    for _ in range(3):
+        state, metrics = step(state, {"x": x, "y": y})
+    ckpt.save(state)
+    ckpt.close()
+    sys.exit(1)  # induced failure: the AM must gang-restart
+
+assert start == 3, f"expected to resume from step 3, got {start}"
+first_loss = None
+for _ in range(2):
+    state, metrics = step(state, {"x": x, "y": y})
+    first_loss = first_loss if first_loss is not None else float(
+        metrics["loss"])
+ckpt.save(state)
+ckpt.close()
+json.dump({"resumed_from": start, "final_step": int(state.step),
+           "loss": first_loss}, open("resume.json", "w"))
+sys.exit(0)
