@@ -246,6 +246,9 @@ def test_tf_config_contract_e2e(pod):
         "tony.worker.instances": "1",
         "tony.ps.instances": "1",
         "tony.application.executes": wl("check_env.py"),
+        # Chief-done policy kills peers on chief exit; make the chief wait
+        # for the worker's env.json so the assertion below can't race it.
+        "tony.chief.command": wl("check_env_wait.py 2"),
         "tony.ps.command": wl("sleep_exit_0.py"),
     }), src_dir=WORKLOADS)
     assert job.exit_code == 0

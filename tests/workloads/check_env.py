@@ -1,7 +1,9 @@
 """Stub workload: dump the env the executor built into ./env.json
-(reference fixture: check_env_and_venv.py)."""
+(reference fixture: check_env_and_venv.py). Written via tmp+rename so a
+peer polling for the file (check_env_wait.py) never sees a partial write."""
 import json
 import os
 
-with open("env.json", "w") as f:
+with open("env.json.tmp", "w") as f:
     json.dump(dict(os.environ), f)
+os.rename("env.json.tmp", "env.json")

@@ -1,0 +1,26 @@
+"""Stub workload for chief-like tasks: dump env to ./env.json, then wait
+until N containers TOTAL (including this one) have written env.json
+before exiting (reference fixture role: check_env_and_venv.py). Needed
+because the chief-done success policy ends the job — and kills
+still-running peers — the moment the chief exits, which would race
+peers' env.json writes.
+"""
+import glob
+import json
+import os
+import sys
+import time
+
+with open("env.json.tmp", "w") as f:
+    json.dump(dict(os.environ), f)
+os.rename("env.json.tmp", "env.json")
+
+want = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+# Below MiniPod.run's 60s default timeout so a missing peer fails as a
+# clean nonzero exit, not a harness TimeoutError.
+deadline = time.time() + 45
+# cwd is containers/<task_id>/src inside the shared job dir.
+while len(glob.glob("../../*/src/env.json")) < want:
+    if time.time() > deadline:
+        sys.exit(3)
+    time.sleep(0.05)
