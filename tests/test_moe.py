@@ -1,0 +1,121 @@
+"""Mixture-of-experts tier (SURVEY.md §2.3 expert parallelism): router
+invariants, dense-MLP equivalence at E=1, aux-loss plumbing, and an
+expert-parallel GSPMD train step on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.models import get_model
+from tony_tpu.models.moe import MoEMLP, router_assignment
+
+
+def _uniformish_gates(g=2, s=16, e=4, seed=0):
+    logits = jax.random.normal(jax.random.PRNGKey(seed), (g, s, e))
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_router_dispatch_invariants():
+    gates = _uniformish_gates()
+    k, cap = 2, 16  # ample capacity: nothing dropped
+    dispatch, combine, aux = router_assignment(gates, k, cap)
+    # Each token occupies exactly k slots, each a 0/1 entry.
+    np.testing.assert_allclose(dispatch.sum(axis=(2, 3)), k, atol=1e-6)
+    assert float(dispatch.max()) == 1.0 and float(dispatch.min()) == 0.0
+    # Combine weights form a convex mixture per token.
+    np.testing.assert_allclose(combine.sum(axis=(2, 3)), 1.0, atol=1e-5)
+    # No expert exceeds capacity; no capacity slot double-booked.
+    assert float(dispatch.sum(axis=(1, 3)).max()) <= cap
+    assert float(dispatch.sum(axis=1).max()) <= 1.0 + 1e-6
+    # Balanced-ish routing → aux loss near its minimum of 1.
+    assert 0.5 < float(aux) < 2.0
+
+
+def test_router_respects_capacity_and_drops():
+    # All tokens want expert 0; capacity 2 → only 2 dispatched per group.
+    gates = jnp.zeros((1, 8, 4)).at[:, :, 0].set(1.0)
+    dispatch, combine, _ = router_assignment(gates, 1, 2)
+    assert float(dispatch[:, :, 0].sum()) == 2.0
+    # Dropped tokens carry zero combine weight (pure residual path).
+    assert float(combine.sum(axis=(2, 3)).max()) <= 1.0 + 1e-6
+    assert float(combine.sum(axis=(2, 3)).min()) == 0.0
+
+
+def test_moe_single_expert_equals_dense_swiglu():
+    """With E=1, k=1 and capacity ≥ T, MoE must reduce to the plain SwiGLU
+    it wraps (combine weight is softmax over one expert = 1)."""
+    d, f, t = 8, 16, 6
+    layer = MoEMLP(dim=d, ffn_hidden=f, n_experts=1, top_k=1,
+                   capacity_factor=1.0, dtype=jnp.float32)
+    import flax.linen as nn
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, t, d))
+    variables = nn.unbox(layer.init(jax.random.PRNGKey(1), x))
+    y = layer.apply(variables, x)
+    p = variables["params"]
+    h = jax.nn.silu(x @ p["w_gate"][0]) * (x @ p["w_up"][0])
+    expected = h @ p["w_down"][0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                               atol=1e-5)
+
+
+def test_moe_model_trains_and_sows_aux_loss():
+    model = get_model("llama-moe-tiny")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    state = train.create_train_state(
+        model, optax.adam(1e-2), tokens, jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]))
+    losses, aux = [], []
+    for _ in range(5):
+        state, metrics = step(state, {"x": tokens})
+        losses.append(float(metrics["loss"]))
+        aux.append(float(metrics["aux_loss"]))
+    assert losses[-1] < losses[0]
+    # Both scanned layers sow: aux ≈ coef · n_layers · (≈1 balanced).
+    assert 0.005 < aux[0] < 0.1
+
+
+def test_moe_remat_scan_path():
+    """The mixtral code path (scan + remat + MoE) at toy shapes."""
+    model = get_model("llama-moe-tiny", remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    state = train.create_train_state(
+        model, optax.adam(1e-2), tokens, jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]))
+    _, metrics = step(state, {"x": tokens})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_moe_expert_parallel_train_step():
+    """EP end-to-end: dp=2 × ep=2 × tp=2 mesh; expert weights sharded over
+    the expert axis; loss finite, decreasing, and matching single-device."""
+    mesh = par.MeshSpec(dp=2, ep=2, tp=2).build(jax.devices())
+    model = get_model("llama-moe-tiny")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 256)
+    state = train.create_train_state(
+        model, optax.adam(1e-3), tokens, jax.random.PRNGKey(0), mesh=mesh)
+    wg = state.params["layers"]["block"]["moe_mlp"]["w_gate"]
+    assert "expert" in tuple(wg.sharding.spec), \
+        f"expert axis unused: {wg.sharding.spec}"
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]),
+        mesh=mesh)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, {"x": tokens})
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+    # Same model/step on one device: the EP sharding must not change the
+    # math (tolerance: bf16 collective reordering).
+    model1 = get_model("llama-moe-tiny")
+    state1 = train.create_train_state(
+        model1, optax.adam(1e-3), tokens, jax.random.PRNGKey(0))
+    step1 = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]))
+    _, m1 = step1(state1, {"x": tokens})
+    np.testing.assert_allclose(losses[0], float(m1["loss"]), rtol=2e-2)

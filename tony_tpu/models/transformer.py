@@ -47,21 +47,32 @@ class TransformerConfig:
     scan_layers: bool = True
     remat: bool = True
     mesh: Optional[Any] = None      # required for attention="ring"
+    # MoE (SURVEY.md §2.3 expert parallelism): >0 swaps the dense MLP for
+    # an expert-parallel MoEMLP in every block.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
     def flops_per_token(self) -> int:
-        """≈6·N_params matmul FLOPs per trained token (fwd+bwd), plus
-        attention's 12·L·dim·seq term — the standard MFU accounting."""
+        """≈6·N_active matmul FLOPs per trained token (fwd+bwd), plus
+        attention's 12·L·dim·seq term — the standard MFU accounting. For
+        MoE, only the top-k experts' FFN params are active per token."""
+        ffn_active = 3 * self.dim * self.ffn_hidden
+        if self.moe_experts > 0:
+            ffn_active = (self.moe_top_k * ffn_active
+                          + self.dim * self.moe_experts)  # + router
         n_params = (
             self.vocab * self.dim * 2  # embed + unembed
             + self.n_layers * (
                 self.dim * self.head_dim
                 * (self.n_heads + 2 * self.n_kv_heads)   # wq, wk, wv
                 + self.n_heads * self.head_dim * self.dim  # wo
-                + 3 * self.dim * self.ffn_hidden))
+                + ffn_active))
         return 6 * n_params + 12 * self.n_layers * self.dim * self.max_seq
 
 
@@ -169,8 +180,16 @@ class Block(nn.Module):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions)
-        x = x + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        if cfg.moe_experts > 0:
+            from tony_tpu.models.moe import MoEMLP
+            mlp = MoEMLP(cfg.dim, cfg.ffn_hidden, cfg.moe_experts,
+                         top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         aux_coef=cfg.moe_aux_coef, dtype=cfg.dtype,
+                         name="moe_mlp")
+        else:
+            mlp = MLP(cfg, name="mlp")
+        x = x + mlp(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
         return x
 
 
@@ -205,7 +224,7 @@ class Transformer(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
@@ -237,6 +256,27 @@ def llama_tiny(**kw) -> Transformer:
     defaults = dict(vocab=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
                     ffn_hidden=128, max_seq=64, attention="reference",
                     scan_layers=True, remat=False)
+    defaults.update(kw)
+    return Transformer(TransformerConfig(**defaults))
+
+
+@register("mixtral-8x7b")
+def mixtral_8x7b(**kw) -> Transformer:
+    """Mixtral-style sparse MoE: 8 experts, top-2 routing, GQA."""
+    defaults = dict(vocab=32000, dim=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=8, ffn_hidden=14336, max_seq=4096,
+                    moe_experts=8, moe_top_k=2)
+    defaults.update(kw)
+    return Transformer(TransformerConfig(**defaults))
+
+
+@register("llama-moe-tiny")
+def llama_moe_tiny(**kw) -> Transformer:
+    """Test-scale MoE config: the mixtral code path at toy shapes."""
+    defaults = dict(vocab=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                    ffn_hidden=128, max_seq=64, attention="reference",
+                    scan_layers=True, remat=False, moe_experts=4,
+                    moe_top_k=2)
     defaults.update(kw)
     return Transformer(TransformerConfig(**defaults))
 

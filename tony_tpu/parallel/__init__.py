@@ -57,6 +57,7 @@ RULES: Tuple[Tuple[str, object], ...] = (
     ("ffn", MODEL),
     ("vocab", MODEL),
     ("expert", EXPERT),
+    ("expert_dim", None),  # router logits' expert dim (tiny, replicated)
     ("stage", None),       # pipeline stages: scan-over-layers axis, unsharded
     ("norm", None),
 )

@@ -99,13 +99,22 @@ def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
 
         def loss_fn(params):
             with nn.logical_axis_rules(rules):
-                logits = state.apply_fn({"params": params}, batch["x"])
-            return loss_of(logits, batch)
+                # mutable="losses": models that sow auxiliary objectives
+                # (e.g. the MoE load-balancing loss) contribute them here;
+                # dense models return an empty collection.
+                logits, sown = state.apply_fn(
+                    {"params": params}, batch["x"], mutable="losses")
+            aux = sum((leaf.sum() for leaf in
+                       jax.tree.leaves(sown.get("losses", {}))),
+                      start=jnp.float32(0.0))
+            return loss_of(logits, batch) + aux, aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads=grads)
         gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        return new_state, {"loss": loss, "grad_norm": gnorm,
+                           "aux_loss": aux}
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
     if mesh is None:
