@@ -74,10 +74,13 @@ def main() -> int:
 
     backend = jax.default_backend()
     on_tpu = backend not in ("cpu",)
-    # Batch 512: best of the r3 sweep (128→0.247, 256→0.266, 512→0.279 MFU
-    # on v5e with bf16 batch-norm; 1024-class head + BN make ResNet
-    # bandwidth-bound, so bigger batches amortize the reductions).
-    batch = int(os.environ.get("BENCH_BATCH", "512" if on_tpu else "8"))
+    # Batch 384: peak of the r3 sweep on v5e (128→0.247, 256→0.266,
+    # 384→0.295, 512→0.292, 640→0.281, 768→0.275 MFU). The step profile
+    # says why bigger stops helping: ~51% of step time is BatchNorm
+    # statistics/backward reductions (bandwidth-bound, linear in batch),
+    # ~45% conv fusions, ~2% maxpool backward — past the MXU's saturation
+    # point extra batch just adds HBM traffic.
+    batch = int(os.environ.get("BENCH_BATCH", "384" if on_tpu else "8"))
     image = int(os.environ.get("BENCH_IMAGE", "224" if on_tpu else "64"))
     # 20 steps/window: the device→host fence costs ~80 ms per window over
     # the relay; longer windows shrink its share of the measurement.

@@ -42,6 +42,8 @@ def router_assignment(gates: jax.Array, top_k: int, capacity: int):
       aux: scalar Switch load-balancing loss (un-scaled).
     """
     g, s, e = gates.shape
+    if top_k > e:
+        raise ValueError(f"top_k={top_k} exceeds n_experts={e}")
     remaining = gates
     dispatch = jnp.zeros((g, s, e, capacity), gates.dtype)
     combine = jnp.zeros((g, s, e, capacity), gates.dtype)

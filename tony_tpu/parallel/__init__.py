@@ -34,11 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # collectives ride the fastest ICI links.
 DATA = "data"       # pure data parallel (replicated params)
 FSDP = "fsdp"       # data parallel with sharded params/optimizer (ZeRO-3)
+PIPE = "pipe"       # pipeline parallelism (GPipe over ppermute)
 EXPERT = "expert"   # MoE expert parallelism
 SEQ = "seq"         # sequence/context parallelism (ring attention)
 MODEL = "model"     # tensor parallelism (megatron-style)
 
-AXES: Tuple[str, ...] = (DATA, FSDP, EXPERT, SEQ, MODEL)
+AXES: Tuple[str, ...] = (DATA, FSDP, PIPE, EXPERT, SEQ, MODEL)
 
 # Logical-axis → mesh-axis rules (flax linen logical partitioning format).
 # Parameters: weights shard over fsdp on their "embed"-like dim and over
@@ -73,23 +74,24 @@ class MeshSpec:
     """
     dp: int = 0
     fsdp: int = 1
+    pp: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1
 
     def resolved_dp(self, n_devices: int) -> int:
-        rest = self.fsdp * self.ep * self.sp * self.tp
+        rest = self.fsdp * self.pp * self.ep * self.sp * self.tp
         if self.dp:
             return self.dp
         if n_devices % rest:
-            raise ValueError(
-                f"{n_devices} devices not divisible by fsdp*ep*sp*tp={rest}")
+            raise ValueError(f"{n_devices} devices not divisible by "
+                             f"fsdp*pp*ep*sp*tp={rest}")
         return n_devices // rest
 
     def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
         devices = list(devices if devices is not None else jax.devices())
         dp = self.resolved_dp(len(devices))
-        shape = (dp, self.fsdp, self.ep, self.sp, self.tp)
+        shape = (dp, self.fsdp, self.pp, self.ep, self.sp, self.tp)
         if int(np.prod(shape)) != len(devices):
             raise ValueError(
                 f"mesh shape {dict(zip(AXES, shape))} needs "
@@ -144,10 +146,12 @@ def constraint(x: jax.Array, mesh: Mesh,
 
 from tony_tpu.parallel.ring_attention import (  # noqa: E402  (re-export)
     ring_attention, ring_attention_sharded)
+from tony_tpu.parallel.pipeline import (  # noqa: E402  (re-export)
+    gpipe, pipelined_lm_logits, stage_split)
 
 __all__ = [
-    "AXES", "DATA", "FSDP", "EXPERT", "SEQ", "MODEL", "RULES",
+    "AXES", "DATA", "FSDP", "PIPE", "EXPERT", "SEQ", "MODEL", "RULES",
     "MeshSpec", "make_mesh", "batch_sharding", "replicated",
     "logical_sharding", "shard_logical", "constraint",
-    "ring_attention", "ring_attention_sharded",
+    "ring_attention", "ring_attention_sharded", "gpipe", "stage_split",
 ]

@@ -1,0 +1,147 @@
+"""Pipeline parallelism: a GPipe schedule over the ``pipe`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.3 "Pipeline parallel (PP)" — TonY
+delegates all parallelism and no runtime implements PP); built here as the
+TPU-native equivalent: stages are laid out over the ``pipe`` mesh axis and
+microbatches flow stage-to-stage via ``jax.lax.ppermute`` (ICI neighbor
+RDMA), the collective-permute pipelining pattern XLA/GSPMD programs use
+instead of framework-level send/recv threads. The whole schedule is one
+``lax.scan`` inside one ``shard_map`` — a single compiled program, no host
+round trips; the backward pass is plain autodiff (reversed ``ppermute``
+ring → the reverse pipeline), so training works through ``jax.grad``
+unchanged.
+
+Schedule: classic GPipe fill/drain. With S stages and M microbatches the
+scan runs ``M + S - 1`` ticks; stage 0 ingests microbatch ``t`` at tick
+``t``, stage ``S-1`` emits microbatch ``t-(S-1)``'s result; bubble fraction
+is ``(S-1)/(M+S-1)`` — callers pick ``M ≥ 4·S`` to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tony_tpu.parallel import DATA, FSDP, PIPE
+
+
+def stage_split(params: Any, n_stages: int) -> Any:
+    """Reshape scan-stacked layer params ``[L, ...]`` into pipeline-stage
+    params ``[S, L/S, ...]`` (stage-major: stage s owns layers
+    ``[s·L/S, (s+1)·L/S)``)."""
+    def reshape(leaf):
+        l = leaf.shape[0]
+        if l % n_stages:
+            raise ValueError(f"{l} layers not divisible by {n_stages} stages")
+        return leaf.reshape((n_stages, l // n_stages) + leaf.shape[1:])
+    return jax.tree.map(reshape, params)
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
+          stage_params: Any, x: jax.Array, mesh: Mesh, *,
+          microbatches: int, pipe_axis: str = PIPE) -> jax.Array:
+    """Run ``x`` through ``S = mesh.shape[pipe_axis]`` pipelined stages.
+
+    Args:
+      stage_fn: ``(params_slice, mb) -> mb_out`` — one stage's compute on
+        one microbatch. Pure per-device function (no collectives); shapes
+        of ``mb_out`` must equal ``mb`` (uniform stages, the usual
+        transformer-block case).
+      stage_params: pytree whose leaves have leading dim ``S``; sharded
+        over ``pipe_axis`` so each device group holds one stage's slice
+        (build with :func:`stage_split`).
+      x: global batch ``[B, ...]``, batch dim sharded over the DP axes as
+        usual; ``B_local`` must divide by ``microbatches``.
+      mesh: the device mesh; composes with data parallelism (each DP group
+        runs its own pipeline) — tensor/seq axes must be 1 inside
+        ``stage_fn`` (keep it collective-free).
+
+    Returns the last stage's outputs in original batch order, replicated
+    over ``pipe_axis`` (like any GSPMD activation).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    dp_axes = tuple(a for a in (DATA, FSDP) if a in mesh.axis_names)
+    dp_size = 1
+    for a in dp_axes:
+        dp_size *= mesh.shape[a]
+    local = x.shape[0] // dp_size
+    if local % microbatches:
+        raise ValueError(
+            f"per-DP-group batch {local} (global {x.shape[0]} / dp "
+            f"{dp_size}) not divisible by microbatches={microbatches}")
+    x_spec = P(dp_axes or None)
+    p_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
+
+    def spmd(params, x_local):
+        params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        idx = jax.lax.axis_index(pipe_axis)
+        m = microbatches
+        mbs = x_local.reshape((m, x_local.shape[0] // m)
+                              + x_local.shape[1:])
+        outs0 = jnp.zeros_like(mbs)
+        buf0 = jnp.zeros_like(mbs[0])
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            # Stage 0 ingests microbatch t (clamped past M: those results
+            # never reach the output window below).
+            inp = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.minimum(t, m - 1), 0, keepdims=False)
+            cur = jnp.where(idx == 0, inp, buf)
+            y = stage_fn(params, cur)
+            # Last stage emits microbatch t-(S-1) once the pipe is full.
+            oidx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            emit = jnp.logical_and(idx == n_stages - 1, t >= n_stages - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
+                                                keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(emit, y, prev), oidx, 0)
+            # Rotate: stage i's output becomes stage i+1's next input
+            # (devices with no sender receive zeros; stage 0 overwrites).
+            buf = jax.lax.ppermute(y, pipe_axis, perm)
+            return (buf, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            tick, (buf0, outs0), jnp.arange(m + n_stages - 1))
+        # Only the last stage wrote non-zeros; psum broadcasts its result
+        # to the whole pipe group.
+        outs = jax.lax.psum(outs, pipe_axis)
+        return outs.reshape(x_local.shape)
+
+    return jax.shard_map(
+        spmd, mesh=mesh, in_specs=(p_specs, x_spec), out_specs=x_spec,
+        check_vma=False)(stage_params, x)
+
+
+def pipelined_lm_logits(params: Any, tokens: jax.Array, cfg: Any,
+                        mesh: Mesh, *, n_stages: int,
+                        microbatches: int) -> jax.Array:
+    """Transformer forward with the scanned block stack run as a GPipe.
+
+    ``params`` is a :class:`~tony_tpu.models.transformer.Transformer`
+    param tree built with ``scan_layers=True`` (block params stacked
+    ``[L, ...]``); embedding and lm_head run outside the pipeline (they
+    are DP/TP work, not stage work). Shared by the multi-chip dryrun and
+    the pipeline tests so the composition has one source of truth.
+    """
+    from tony_tpu.models.transformer import Block, RMSNorm  # lazy: no cycle
+
+    positions = jnp.arange(tokens.shape[1])
+    block = Block(cfg)
+
+    def stage_fn(block_params, x):
+        def body(h, lp):
+            return block.apply({"params": lp}, h, positions), None
+        h, _ = jax.lax.scan(body, x, block_params)
+        return h
+
+    x = jnp.take(params["embedding"], tokens, axis=0).astype(cfg.dtype)
+    x = gpipe(stage_fn, stage_split(params["layers"]["block"], n_stages),
+              x, mesh, microbatches=microbatches)
+    x = RMSNorm(cfg.norm_eps).apply({"params": params["final_norm"]}, x)
+    logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
