@@ -160,18 +160,23 @@ def bench_llm(peak: float) -> dict:
 
     # r3 sweep on v5e (dim 1024, 12 layers, adamw, bf16): head_dim 64→128
     # was the big win (MXU contraction depth), 0.375→0.480 MFU; unrolling
-    # the layer scan +5.6pt; batch 16 × seq 512 (same tokens/step)
-    # +4.7pt → 0.583. Deeper (L24) and wider (dim 2048) variants measured
-    # lower or OOMed; all knobs stay env-overridable.
-    batch = int(os.environ.get("BENCH_LLM_BATCH", "16"))
+    # the layer scan +5.6pt; batch 16 × seq 512 +4.7pt → 0.583; batch 32
+    # +3.9pt → 0.622 (b64 OOMs on the f32-logits temp). An FFN-heavy
+    # variant (ffn 8192, BENCH_LLM_FFN) measures 0.659 — reported via env
+    # knob, not defaulted: the headline stays Llama-proportioned. heads=16
+    # (head_dim 64) drops to 0.474; seq 1024 at b8 to 0.551.
+    batch = int(os.environ.get("BENCH_LLM_BATCH", "32"))
     seq = int(os.environ.get("BENCH_LLM_SEQ", "512"))
     heads = int(os.environ.get("BENCH_LLM_HEADS", "8"))
+    dim = int(os.environ.get("BENCH_LLM_DIM", "1024"))
+    ffn = int(os.environ.get("BENCH_LLM_FFN", "4096"))
+    layers = int(os.environ.get("BENCH_LLM_LAYERS", "12"))
     remat = os.environ.get("BENCH_LLM_REMAT", "0") == "1"
     scan_layers = os.environ.get("BENCH_LLM_SCAN", "0") == "1"
     model = get_model(
-        "llama2-7b", dim=1024, n_layers=12, n_heads=heads, n_kv_heads=heads,
-        ffn_hidden=4096, vocab=32768, max_seq=seq, attention="flash",
-        scan_layers=scan_layers, remat=remat)
+        "llama2-7b", dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=heads, ffn_hidden=ffn, vocab=32768, max_seq=seq,
+        attention="flash", scan_layers=scan_layers, remat=remat)
     cfg = model.cfg
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab)

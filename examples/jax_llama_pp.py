@@ -1,0 +1,84 @@
+"""Pipeline-parallel Llama training (SURVEY.md §2.3 PP — a TPU-build
+capability the reference never had).
+
+The transformer's scanned block stack runs as a GPipe over the ``pipe``
+mesh axis (microbatches rotating via ppermute), composed with data
+parallelism; embedding/head stay outside the pipeline. The whole schedule
+— forward, reverse-pipeline backward, optimizer update — is one jitted
+program.
+
+Submit (2 hosts)::
+
+    tony submit --framework jax --src_dir examples \\
+        --executes "python jax_llama_pp.py" \\
+        --conf tony.worker.instances=2 --conf tony.worker.tpus=4
+
+Env knobs: MODEL, MESH_PP, MICROBATCHES, STEPS.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+
+import tony_tpu.distributed as dist
+
+dist.initialize()
+
+import jax.numpy as jnp
+import optax
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.models import get_model
+from tony_tpu.parallel import pipelined_lm_logits
+
+
+def main():
+    pp = int(os.environ.get("MESH_PP", str(min(2, jax.device_count()))))
+    mesh = par.MeshSpec(pp=pp).build()
+    microbatches = int(os.environ.get("MICROBATCHES", str(2 * pp)))
+
+    model = get_model(os.environ.get("MODEL", "llama-tiny"))
+    cfg = model.cfg
+    dp = mesh.shape["data"]
+    # BATCH is the GLOBAL batch; each process feeds its local shard via
+    # train.global_batch (cf. jax_llama_sharded.py).
+    batch = int(os.environ.get("BATCH", str(microbatches * dp)))
+    local = batch // max(1, jax.process_count())
+    seq = min(cfg.max_seq, int(os.environ.get("SEQ", "64")))
+
+    sample = jnp.zeros((batch, seq), jnp.int32)
+    state = train.create_train_state(
+        model, optax.adamw(3e-4), sample, jax.random.PRNGKey(1), mesh=mesh)
+
+    def loss_fn(params, tokens):
+        logits = pipelined_lm_logits(params, tokens, cfg, mesh,
+                                     n_stages=pp, microbatches=microbatches)
+        return train.next_token_loss(logits, tokens)
+
+    @jax.jit
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        return state.apply_gradients(grads=grads), loss
+
+    losses = []
+    for i in range(int(os.environ.get("STEPS", "5"))):
+        local_tokens = jax.random.randint(
+            jax.random.PRNGKey(1000 * jax.process_index() + i),
+            (local, seq), 0, cfg.vocab)
+        tokens = train.global_batch(mesh, {"x": local_tokens})["x"]
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+        if jax.process_index() == 0:
+            print(f"step {i} loss {losses[-1]:.4f}")
+
+    if jax.process_index() == 0:
+        Path("pp_losses.json").write_text(json.dumps({
+            "mesh": dict(mesh.shape), "microbatches": microbatches,
+            "losses": losses}))
+
+
+if __name__ == "__main__":
+    main()

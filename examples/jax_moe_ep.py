@@ -1,0 +1,73 @@
+"""Expert-parallel mixture-of-experts training (SURVEY.md §2.3 EP — a
+TPU-build capability the reference never had).
+
+One jitted train step over a dp×ep×tp mesh: expert FFN weights shard over
+the ``expert`` axis (GSPMD turns the dispatch einsums into all_to_all over
+ICI), the Switch load-balancing aux loss flows through the train harness's
+``losses`` collection automatically.
+
+Submit (2 hosts)::
+
+    tony submit --framework jax --src_dir examples \\
+        --executes "python jax_moe_ep.py" \\
+        --conf tony.worker.instances=2 --conf tony.worker.tpus=4
+
+Env knobs: MODEL (llama-moe-tiny|mixtral-8x7b), MESH_EP/MESH_TP, STEPS.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+
+import tony_tpu.distributed as dist
+
+dist.initialize()
+
+import jax.numpy as jnp
+import optax
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.models import get_model
+
+
+def main():
+    ep = int(os.environ.get("MESH_EP", str(min(2, jax.device_count()))))
+    tp = int(os.environ.get("MESH_TP", "1"))
+    mesh = par.MeshSpec(ep=ep, tp=tp).build()
+
+    model = get_model(os.environ.get("MODEL", "llama-moe-tiny"))
+    cfg = model.cfg
+    # BATCH is the GLOBAL batch; each process contributes its local shard
+    # through train.global_batch (cf. jax_llama_sharded.py).
+    batch = int(os.environ.get("BATCH", str(2 * mesh.shape["data"])))
+    local = batch // max(1, jax.process_count())
+    seq = min(cfg.max_seq, int(os.environ.get("SEQ", "64")))
+
+    sample = jnp.zeros((batch, seq), jnp.int32)
+    state = train.create_train_state(
+        model, optax.adamw(3e-4), sample, jax.random.PRNGKey(0), mesh=mesh)
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]),
+        mesh=mesh)
+
+    losses, aux = [], []
+    for i in range(int(os.environ.get("STEPS", "5"))):
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1000 * jax.process_index() + i),
+            (local, seq), 0, cfg.vocab)
+        state, metrics = step(state, train.global_batch(mesh, {"x": tokens}))
+        losses.append(float(metrics["loss"]))
+        aux.append(float(metrics["aux_loss"]))
+        if jax.process_index() == 0:
+            print(f"step {i} loss {losses[-1]:.4f} aux {aux[-1]:.4f}")
+
+    if jax.process_index() == 0:
+        Path("moe_losses.json").write_text(json.dumps({
+            "mesh": dict(mesh.shape), "losses": losses, "aux": aux}))
+
+
+if __name__ == "__main__":
+    main()

@@ -237,6 +237,48 @@ def test_jax_distributed_dp_training(pod):
     assert data["losses"][-1] < data["losses"][0]
 
 
+def test_jax_distributed_expert_parallel_training(pod):
+    """Expert parallelism across processes: 2 executors form one ep=2 mesh;
+    the MoE dispatch all_to_all crosses the process boundary and the aux
+    loss flows back through the train harness."""
+    job = pod.run(props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "2",
+        "tony.application.executes": wl("jax_ep_train.py"),
+        "tony.am.gang-allocation-timeout-ms": "120000",
+        "tony.task.max-missed-heartbeats": "100",  # slow CPU compile
+    }), src_dir=WORKLOADS, timeout=240)
+    for t in job.session.tasks():
+        assert t.status is TaskStatus.SUCCEEDED, (t.task_id, t.diagnostics)
+    assert job.exit_code == 0
+    [result] = Path(job.am.job_dir).glob("containers/*/src/ep_losses.json")
+    data = json.loads(result.read_text())
+    assert data["num_processes"] == 2
+    assert data["mesh"]["expert"] == 2
+    assert data["losses"][-1] < data["losses"][0]
+    assert all(a > 0 for a in data["aux"])
+
+
+def test_jax_distributed_pipeline_parallel_training(pod):
+    """Pipeline parallelism across processes: 2 executors form one pp=2
+    mesh; the GPipe ppermute ring crosses the process boundary."""
+    job = pod.run(props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "2",
+        "tony.application.executes": wl("jax_pp_train.py"),
+        "tony.am.gang-allocation-timeout-ms": "120000",
+        "tony.task.max-missed-heartbeats": "100",  # slow CPU compile
+    }), src_dir=WORKLOADS, timeout=240)
+    for t in job.session.tasks():
+        assert t.status is TaskStatus.SUCCEEDED, (t.task_id, t.diagnostics)
+    assert job.exit_code == 0
+    [result] = Path(job.am.job_dir).glob("containers/*/src/pp_losses.json")
+    data = json.loads(result.read_text())
+    assert data["num_processes"] == 2
+    assert data["mesh"]["pipe"] == 2
+    assert data["losses"][-1] < data["losses"][0]
+
+
 def test_tf_config_contract_e2e(pod):
     """Graduation configs ①/② (SURVEY.md §6): a tensorflow-framework job's
     executors build a correct TF_CONFIG over ps/worker/chief, live."""

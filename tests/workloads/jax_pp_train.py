@@ -1,0 +1,68 @@
+"""Distributed pipeline-parallel training stub: 2 processes form one pp=2
+mesh; the GPipe ppermute ring crosses the process boundary (the class of
+breakage single-process pipeline tests can't catch). Process 0 writes the
+loss history."""
+
+import json
+import os
+from pathlib import Path
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import tony_tpu.distributed as dist
+
+initialized = dist.initialize()
+assert initialized, "expected multi-process TonY env"
+
+import jax.numpy as jnp
+import optax
+
+from tony_tpu import parallel as par
+from tony_tpu import train
+from tony_tpu.models import get_model
+from tony_tpu.parallel import pipelined_lm_logits
+
+mesh = par.MeshSpec(dp=jax.device_count() // 2, pp=2).build()
+model = get_model("llama-tiny")
+cfg = model.cfg
+
+# 2 microbatches x 2 rows per DP group (the executor's device count is
+# env-dependent, so size the batch from the mesh, not a constant).
+glob = mesh.shape["data"] * 4
+local_batch = glob // jax.process_count()
+sample = jnp.zeros((glob, 16), jnp.int32)
+state = train.create_train_state(
+    model, optax.adam(1e-2), sample, jax.random.PRNGKey(0), mesh=mesh)
+
+
+def loss_fn(params, tokens):
+    logits = pipelined_lm_logits(params, tokens, cfg, mesh,
+                                 n_stages=2, microbatches=2)
+    return train.next_token_loss(logits, tokens)
+
+
+@jax.jit
+def step(state, tokens):
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+    return state.apply_gradients(grads=grads), loss
+
+
+tokens_local = jax.random.randint(
+    jax.random.PRNGKey(jax.process_index()), (local_batch, 16), 0, cfg.vocab)
+tokens = train.global_batch(mesh, {"x": tokens_local})["x"]
+
+losses = []
+for _ in range(6):
+    state, loss = step(state, tokens)
+    losses.append(float(loss))
+
+if jax.process_index() == 0:
+    Path("pp_losses.json").write_text(json.dumps({
+        "num_processes": jax.process_count(),
+        "num_devices": jax.device_count(),
+        "mesh": dict(mesh.shape),
+        "losses": losses,
+    }))

@@ -7,7 +7,7 @@ for that delegated layer, built the way JAX programs scale (SURVEY.md §2.3
 "TPU-build equivalent" column):
 
 * one :class:`MeshSpec` describes the whole parallelism layout
-  (dp/fsdp/tp/sp/ep) and builds a :class:`jax.sharding.Mesh`;
+  (dp/fsdp/pp/ep/sp/tp) and builds a :class:`jax.sharding.Mesh`;
 * parameters and activations carry *logical* axis names; :data:`RULES` maps
   them onto mesh axes (GSPMD then inserts the collectives — ``psum`` for DP
   grads over ICI replaces NCCL allreduce, ``all_gather``/``reduce_scatter``

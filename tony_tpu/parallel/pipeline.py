@@ -127,6 +127,12 @@ def pipelined_lm_logits(params: Any, tokens: jax.Array, cfg: Any,
     ``[L, ...]``); embedding and lm_head run outside the pipeline (they
     are DP/TP work, not stage work). Shared by the multi-chip dryrun and
     the pipeline tests so the composition has one source of truth.
+
+    The embed/head tail here deliberately mirrors ``Transformer.__call__``
+    (bf16 embed cast, bf16 lm_head matmul, f32 logits) — flax compact
+    modules can't expose their head as a separately-applicable method
+    without restructuring; ``test_pipelined_llama_blocks_match_and_train``
+    pins this copy against ``model.apply`` so drift fails loudly.
     """
     from tony_tpu.models.transformer import Block, RMSNorm  # lazy: no cycle
 
