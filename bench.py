@@ -176,7 +176,8 @@ def bench_llm(peak: float) -> dict:
     model = get_model(
         "llama2-7b", dim=dim, n_layers=layers, n_heads=heads,
         n_kv_heads=heads, ffn_hidden=ffn, vocab=32768, max_seq=seq,
-        attention="flash", scan_layers=scan_layers, remat=remat)
+        attention=os.environ.get("BENCH_LLM_ATTN", "flash"),
+        scan_layers=scan_layers, remat=remat)
     cfg = model.cfg
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab)

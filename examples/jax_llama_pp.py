@@ -58,7 +58,9 @@ def main():
                                      n_stages=pp, microbatches=microbatches)
         return train.next_token_loss(logits, tokens)
 
-    @jax.jit
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
         return state.apply_gradients(grads=grads), loss

@@ -201,6 +201,38 @@ def test_execution_timeout_kills_user_process(pod):
     assert "timed out" in t.diagnostics
 
 
+def test_docker_wrapped_executor_e2e(pod, tmp_path, monkeypatch):
+    """tony.docker.enabled wraps every executor launch in `docker run`; a
+    fake docker shim on PATH records the invocation and execs the wrapped
+    command, so the whole job must still pass through it."""
+    shim_dir = tmp_path / "shims"
+    shim_dir.mkdir()
+    marker = tmp_path / "docker_calls.log"
+    shim = shim_dir / "docker"
+    shim.write_text(
+        "#!/bin/sh\n"
+        f"echo \"$@\" >> {marker}\n"
+        # Drop everything up to and including the image token (run --rm
+        # --network=host -v ... -w ... -e KEY=V ... <image>), then exec
+        # the wrapped command on the host.
+        "while [ \"$1\" != \"tony-test-img:latest\" ]; do shift; done\n"
+        "shift\n"
+        "exec \"$@\"\n")
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{shim_dir}:{os.environ['PATH']}")
+    job = pod.run(props(**{
+        "tony.worker.instances": "1",
+        "tony.docker.enabled": "true",
+        "tony.docker.containers.image": "tony-test-img:latest",
+    }), src_dir=WORKLOADS)
+    assert job.exit_code == 0
+    calls = marker.read_text().strip().splitlines()
+    assert len(calls) == 1
+    assert calls[0].startswith("run --rm --network=host -v ")
+    assert " tony-test-img:latest " in calls[0]
+    assert " -e TONY_AM_ADDRESS=" in calls[0]  # curated env rode -e
+
+
 def test_security_token_plumbed_end_to_end(pod):
     job = pod.run(props(**{
         "tony.worker.instances": "1",

@@ -196,3 +196,33 @@ def test_tpuvm_chip_accounting_and_venv_rewrite(tmp_path):
     argv = sched.build_remote_command(ContainerLaunch(
         job_type="w", index=0, env={"TONY_VENV": str(venv_zip)}), "a")
     assert "export TONY_VENV=/tmp/tt/venv-stage/venv.tar.gz;" in argv[2]
+
+
+def test_docker_wrap_command_unit():
+    import pytest
+    from tony_tpu.conf import TonyConfig
+    from tony_tpu.scheduler import docker_wrap_command
+    argv = ["python", "-m", "tony_tpu.executor"]
+    # Disabled (default): passthrough untouched.
+    assert docker_wrap_command(TonyConfig(), argv) == argv
+    # Enabled: wrapped in docker run with the curated env (-e), job-dir
+    # bind mount (-v), container workdir (-w), and the configured image —
+    # the YARN launch-context contract, not a bare image invocation.
+    conf = TonyConfig({"tony.docker.enabled": "true",
+                       "tony.docker.containers.image": "img:1"})
+    wrapped = docker_wrap_command(
+        conf, argv, env={"TONY_AM_ADDRESS": "h:1", "TONY_JOB_NAME": "w"},
+        workdir="/jobs/app1/containers/c1", mounts=["/jobs/app1"])
+    assert wrapped[:2] == ["docker", "run"]
+    assert wrapped[-3:] == argv
+    img_at = wrapped.index("img:1")
+    head = wrapped[:img_at]
+    assert "-v" in head and "/jobs/app1:/jobs/app1" in head
+    assert "-w" in head and "/jobs/app1/containers/c1" in head
+    assert "TONY_AM_ADDRESS=h:1" in head and "TONY_JOB_NAME=w" in head
+    # Host environ must NOT leak into the container env.
+    assert not any(a.startswith("PATH=") for a in head)
+    # Enabled without an image: loud failure, not a silent no-op.
+    with pytest.raises(ValueError, match="tony.docker.containers.image"):
+        docker_wrap_command(
+            TonyConfig({"tony.docker.enabled": "true"}), argv)

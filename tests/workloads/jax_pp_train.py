@@ -44,7 +44,10 @@ def loss_fn(params, tokens):
     return train.next_token_loss(logits, tokens)
 
 
-@jax.jit
+import functools
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def step(state, tokens):
     loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
     return state.apply_gradients(grads=grads), loss

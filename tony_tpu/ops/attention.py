@@ -68,7 +68,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     bq, d = q_ref.shape
     t = k_ref.shape[0]
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+    # Matmul inputs stay in their storage dtype (bf16): bf16×bf16 products
+    # are exact in the MXU's f32 accumulator, so this loses nothing over
+    # upcast-then-dot — and doesn't rely on Mosaic folding converts back
+    # out of an f32 matmul (measured parity on v5e: the fold does happen
+    # today, but it's the compiler's choice, not the kernel's contract).
+    # Softmax math (max/exp/normalizer) runs in f32; p casts back for the
+    # PV matmul.
+    q = q_ref[:]
 
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
@@ -82,11 +89,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [Bq, Bk]
+            preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
         if causal:
             s = _causal_mask(s, qi, bq, kb, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -94,7 +101,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -111,17 +118,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     bq, d = q_ref.shape
     t = k_ref.shape[0]
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
-    o = o_ref[:].astype(jnp.float32)
+    # bf16 matmul operands / f32 accumulation + f32 softmax math — see the
+    # forward kernel's dtype note.
+    q = q_ref[:]
+    do = do_ref[:]
+    D = jnp.sum(do.astype(jnp.float32) * o_ref[:].astype(jnp.float32),
+                axis=-1, keepdims=True)                  # [Bq, 1]
     lse = lse_ref[:, 0:1]                                # [Bq, 1]
-    D = jnp.sum(do * o, axis=-1, keepdims=True)          # [Bq, 1]
     num_kb = pl.cdiv((qi + 1) * bq, block_k) if causal else pl.cdiv(
         t, block_k)
 
     def body(kb, dq):
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -131,7 +140,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - D)
+        ds = (p * (dp - D)).astype(k_blk.dtype)
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -148,16 +157,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     bk, d = k_ref.shape
     t = q_ref.shape[0]
     kj = pl.program_id(1)
-    k_blk = k_ref[:].astype(jnp.float32)
-    v_blk = v_ref[:].astype(jnp.float32)
+    # bf16 matmul operands / f32 accumulation + f32 softmax math — see the
+    # forward kernel's dtype note.
+    k_blk = k_ref[:]
+    v_blk = v_ref[:]
     num_qb = pl.cdiv(t, block_q)
     qb0 = (kj * bk) // block_q if causal else 0
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qb * block_q, block_q), :]
+        do = do_ref[pl.ds(qb * block_q, block_q), :]
+        o = o_ref[pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[pl.ds(qb * block_q, block_q), 0:1]  # [Bq, 1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
@@ -166,13 +177,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             s = _causal_mask(s, qb, block_q, kj, bk)
         p = jnp.exp(s - lse)                              # [Bq, Bk]
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        D = jnp.sum(do * o, axis=-1, keepdims=True)
-        ds = p * (dp - D)
+        D = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+        ds = (p * (dp - D)).astype(q.dtype)
         dk_new = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale

@@ -153,5 +153,6 @@ __all__ = [
     "AXES", "DATA", "FSDP", "PIPE", "EXPERT", "SEQ", "MODEL", "RULES",
     "MeshSpec", "make_mesh", "batch_sharding", "replicated",
     "logical_sharding", "shard_logical", "constraint",
-    "ring_attention", "ring_attention_sharded", "gpipe", "stage_split",
+    "ring_attention", "ring_attention_sharded", "gpipe",
+    "pipelined_lm_logits", "stage_split",
 ]

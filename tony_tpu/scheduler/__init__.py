@@ -32,7 +32,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from tony_tpu import constants
 from tony_tpu.util import child_pythonpath
@@ -139,15 +139,22 @@ class LocalProcessScheduler(ContainerScheduler):
         workdir = self.job_dir / "containers" / cid
         workdir.mkdir(parents=True, exist_ok=True)
         log = open(workdir / constants.EXECUTOR_LOG_NAME, "ab")
+        # Curated task env (the YARN launch-context analogue): what the
+        # executor needs, distinct from the host environ it also inherits
+        # when running un-dockerized.
+        task_env = dict(launch.env)
+        task_env[constants.ENV_CONTAINER_ID] = cid
+        task_env.setdefault(constants.ENV_LOG_DIR, str(workdir))
+        task_env["TONY_EXECUTOR_HOST"] = self.host
         env = dict(os.environ)
-        env.update(launch.env)
-        env[constants.ENV_CONTAINER_ID] = cid
-        env.setdefault(constants.ENV_LOG_DIR, str(workdir))
-        env["TONY_EXECUTOR_HOST"] = self.host
+        env.update(task_env)
         env["PYTHONPATH"] = child_pythonpath(env)
+        task_env["PYTHONPATH"] = env["PYTHONPATH"]
         argv = [sys.executable, "-m", "tony_tpu.executor"]
         if self.conf is not None:
-            argv = docker_wrap_command(self.conf, argv)
+            argv = docker_wrap_command(self.conf, argv, env=task_env,
+                                       workdir=str(workdir),
+                                       mounts=[str(self.job_dir)])
         proc = subprocess.Popen(
             argv, env=env, cwd=workdir, stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True)
@@ -232,12 +239,20 @@ def scheduler_from_conf(conf, job_dir: str | Path,
     return None  # caller builds LocalProcessScheduler with its own args
 
 
-def docker_wrap_command(conf, argv: List[str]) -> List[str]:
-    """When ``tony.docker.enabled`` is set, wrap an executor launch command in
-    ``docker run`` with the configured image (reference: the YARN docker
+def docker_wrap_command(conf, argv: List[str],
+                        env: Optional[Dict[str, str]] = None,
+                        workdir: Optional[str] = None,
+                        mounts: Sequence[str] = ()) -> List[str]:
+    """When ``tony.docker.enabled`` is set, wrap an executor launch command
+    in ``docker run`` with the configured image (reference: the YARN docker
     runtime env ``YARN_CONTAINER_RUNTIME_TYPE=docker`` — SURVEY.md §2.1
-    "Docker support"). Applied by ``LocalProcessScheduler.launch`` when it
-    was constructed with the job config."""
+    "Docker support"). Mirrors the YARN launch-context contract: the
+    curated task ``env`` rides ``-e`` (not the host's full environ), each
+    of ``mounts`` (the job dir, so conf/src/venv localization resolve) is
+    bind-mounted at the same path, and ``workdir`` becomes the container
+    cwd. The image must provide python + tony_tpu. Applied by
+    ``LocalProcessScheduler.launch`` when it was constructed with the job
+    config."""
     from tony_tpu import conf as conf_mod
     if not conf.get_bool(conf_mod.DOCKER_ENABLED, False):
         return argv
@@ -245,8 +260,14 @@ def docker_wrap_command(conf, argv: List[str]) -> List[str]:
     if not image:
         raise ValueError("tony.docker.enabled=true requires "
                          "tony.docker.containers.image")
-    return ["docker", "run", "--rm", "--network=host",
-            image] + argv
+    cmd = ["docker", "run", "--rm", "--network=host"]
+    for m in mounts:
+        cmd += ["-v", f"{m}:{m}"]
+    if workdir:
+        cmd += ["-w", str(workdir)]
+    for key in sorted(env or ()):
+        cmd += ["-e", f"{key}={env[key]}"]
+    return cmd + [image] + argv
 
 
 class TpuVmScheduler(ContainerScheduler):
