@@ -201,6 +201,33 @@ def test_execution_timeout_kills_user_process(pod):
     assert "timed out" in t.diagnostics
 
 
+def test_wide_gang_e2e(pod):
+    """Scale sanity: a 16-task gang (3 jobtypes) through the full
+    client→AM→executor path — registration storm, gang barrier, success
+    policy over mixed types, event log completeness."""
+    job = pod.run(props(**{
+        "tony.worker.instances": "12",
+        "tony.evaluator.instances": "3",
+        "tony.ps.instances": "1",
+        "tony.ps.command": wl("sleep_exit_0.py"),
+        "tony.application.untracked.jobtypes": "ps",
+        "tony.am.gang-allocation-timeout-ms": "120000",
+    }), src_dir=WORKLOADS, timeout=240)
+    assert job.exit_code == 0
+    tasks = list(job.session.tasks())
+    assert len(tasks) == 16
+    tracked = [t for t in tasks if t.tracked]
+    assert len(tracked) == 15
+    assert all(t.status is TaskStatus.SUCCEEDED for t in tracked)
+    # Every tracked task made it into the finished event log.
+    from tony_tpu import events as ev
+    [jhist] = (Path(job.am.job_dir) / "history" / "finished").glob("*.jhist")
+    finished = {f"{r['payload']['job_type']}:{r['payload']['index']}"
+                for r in ev.read_events(jhist)
+                if r["type"] == "TASK_FINISHED"}
+    assert {t.task_id for t in tracked} <= finished
+
+
 def test_docker_wrapped_executor_e2e(pod, tmp_path, monkeypatch):
     """tony.docker.enabled wraps every executor launch in `docker run`; a
     fake docker shim on PATH records the invocation and execs the wrapped

@@ -36,4 +36,4 @@ tony-mini (docker pseudo-cluster)           tony_tpu.minipod (in-process)
 ==========================================  =========================================
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
