@@ -161,10 +161,11 @@ def bench_llm(peak: float) -> dict:
     # r3 sweep on v5e (dim 1024, 12 layers, adamw, bf16): head_dim 64→128
     # was the big win (MXU contraction depth), 0.375→0.480 MFU; unrolling
     # the layer scan +5.6pt; batch 16 × seq 512 +4.7pt → 0.583; batch 32
-    # +3.9pt → 0.622 (b64 OOMs on the f32-logits temp). An FFN-heavy
-    # variant (ffn 8192, BENCH_LLM_FFN) measures 0.659 — reported via env
-    # knob, not defaulted: the headline stays Llama-proportioned. heads=16
-    # (head_dim 64) drops to 0.474; seq 1024 at b8 to 0.551.
+    # +3.9pt → 0.622 (b64 OOMs on the f32-logits temp); flash block size
+    # 128→256 +5pt → 0.673. An FFN-heavy variant (ffn 8192, BENCH_LLM_FFN)
+    # measured 0.659 pre-block-win — reported via env knob, not defaulted:
+    # the headline stays Llama-proportioned. heads=16 (head_dim 64) drops
+    # to 0.474; seq 1024 at b8 to 0.551.
     batch = int(os.environ.get("BENCH_LLM_BATCH", "32"))
     seq = int(os.environ.get("BENCH_LLM_SEQ", "512"))
     heads = int(os.environ.get("BENCH_LLM_HEADS", "8"))

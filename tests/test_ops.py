@@ -28,11 +28,34 @@ def test_flash_kernel_matches_reference(causal):
 
 
 def test_flash_kernel_uneven_blocks():
-    # Causal self-attention with T not divisible by the blocks takes the
-    # zero-pad kernel path — still exact.
-    q, k, v = rand_qkv(t=48)
+    # Causal self-attention with T not divisible by ANY tile-legal block
+    # (t=40 isn't a multiple of 16) takes the zero-pad kernel path —
+    # still exact.
+    q, k, v = rand_qkv(t=40)
     out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
     ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_block_shrinks_to_dividing_size(causal):
+    # T divisible by 16 but not by the requested block must shrink the
+    # block (96 @ limit 64 → 48) and run the kernel unpadded — no
+    # fallback warning even non-causal (the t=384-at-default-256 case).
+    import warnings
+
+    from tony_tpu.ops import attention as att
+
+    assert att._fit_block(64, 96) == 48
+    q, k, v = rand_qkv(t=96)
+    att._warned.clear()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = flash_attention(q, k, v, causal=causal, block_q=64,
+                              block_k=64, interpret=True)
+    assert not caught
+    ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
 

@@ -290,6 +290,16 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(limit: int, t: int) -> int:
+    """Largest block ≤ limit that divides ``t`` and is a multiple of the
+    16-row sublane tile; 0 if none exists (ragged ``t``)."""
+    b = min(limit, t)
+    b -= b % 16
+    while b >= 16 and t % b:
+        b -= 16
+    return b if b >= 16 else 0
+
+
 def _warn_fallback(reason: str) -> None:
     """One warning per distinct reason when a TPU run leaves the kernel
     path — the reference fallback materializes the T×T score matrix, an
@@ -309,7 +319,7 @@ _warned: set = set()
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 256, block_k: int = 256,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused attention over ``[batch, heads, seq, head_dim]``.
 
@@ -320,6 +330,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block boundary (end-padded keys sit above the diagonal for every real
     query, so the causal mask already excludes them); other ragged cases
     fall back to the reference with a one-time warning.
+
+    Default blocks are 256: 128² score tiles are MXU-pipeline-latency
+    dominated (measured 14.5→9.7 ms per layer fwd+bwd going 128→256 at
+    b32·h8·t512·d128 on v5e; 512 measured equal to 256 with more VMEM
+    pressure).
     """
     d = q.shape[-1]
     scale = d ** -0.5 if scale is None else scale
@@ -333,9 +348,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # in-kernel pl.ds(kb*block, block) K/V slices need block to be a
     # multiple of the sublane tile (8 for f32, 16 for bf16 — 16 covers
     # both), else Mosaic rejects the unaligned slice even when the block
-    # equals the array dim.
-    bq, bk = min(block_q, t), min(block_k, tk)
-    if t % bq == 0 and tk % bk == 0 and bq % 16 == 0 and bk % 16 == 0:
+    # equals the array dim. Shrink to the largest dividing tile-legal
+    # block before resorting to padding or fallback, so e.g. t=384 runs
+    # the kernel unpadded at block 192 rather than padding to 512.
+    bq, bk = _fit_block(block_q, t), _fit_block(block_k, tk)
+    if bq and bk:
         return _flash(q, k, v, causal, scale, bq, bk, interpret)
     if not (causal and t == tk):
         _warn_fallback(
@@ -359,7 +376,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                             mesh, causal: bool = True,
                             scale: Optional[float] = None,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: int = 256, block_k: int = 256,
                             model_axis: str = "model",
                             interpret: Optional[bool] = None) -> jax.Array:
     """Global-array entry point: shard_map the flash kernel over the mesh —
