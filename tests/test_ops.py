@@ -153,3 +153,53 @@ def test_cpu_dispatch_uses_reference():
     out = flash_attention(q, k, v)
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_packed_matches_classic(causal):
+    # The packed [B, T, H*D] entry must agree with the classic layout on
+    # values AND grads (interpret mode; d=128 for lane alignment).
+    b, h, t, d = 2, 2, 64, 128
+    q, k, v = rand_qkv(b=b, h=h, t=t, d=d)
+    from tony_tpu.ops import flash_attention_packed
+
+    pack = lambda x: x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+    def loss_packed(q, k, v):
+        return flash_attention_packed(
+            pack(q), pack(k), pack(v), h, causal=causal, block_q=16,
+            block_k=16, interpret=True).sum()
+
+    def loss_classic(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=16,
+                               block_k=16, interpret=True).sum()
+
+    np.testing.assert_allclose(float(loss_packed(q, k, v)),
+                               float(loss_classic(q, k, v)), rtol=1e-4)
+    gp = jax.grad(loss_packed, (0, 1, 2))(q, k, v)
+    gc = jax.grad(loss_classic, (0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_packed_bad_head_dim_falls_back():
+    # head_dim not lane-aligned: warn + unpacked fallback, still correct.
+    import warnings
+
+    from tony_tpu.ops import attention as att
+    from tony_tpu.ops import flash_attention_packed
+
+    b, h, t, d = 2, 3, 32, 16
+    q, k, v = rand_qkv(b=b, h=h, t=t, d=d)
+    pack = lambda x: x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+    att._warned.clear()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = flash_attention_packed(pack(q), pack(k), pack(v), h,
+                                     block_q=16, block_k=16, interpret=True)
+    assert any("head_dim" in str(w.message) for w in caught)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out.reshape(b, t, h, d).transpose(0, 2, 1, 3)),
+        np.asarray(ref), atol=2e-5, rtol=2e-5)

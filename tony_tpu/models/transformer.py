@@ -76,12 +76,18 @@ class TransformerConfig:
         return 6 * n_params + 12 * self.n_layers * self.dim * self.max_seq
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding over [B, H, T, D] with positions [T]."""
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         seq_axis: int = 2) -> jax.Array:
+    """Rotary embedding with positions [T]; the sequence dim sits at
+    ``seq_axis`` (2 for [B, H, T, D], 1 for the packed [B, T, H, D])."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    shape = [1] * x.ndim
+    shape[seq_axis] = angles.shape[0]
+    shape[-1] = d // 2
+    cos = jnp.cos(angles).reshape(shape)
+    sin = jnp.sin(angles).reshape(shape)
     x1, x2 = x[..., ::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
     y2 = x1 * sin + x2 * cos
@@ -121,6 +127,30 @@ class Attention(nn.Module):
         q = dense(nh * hd, ("embed", "heads"), "wq")(x)
         k = dense(nkv * hd, ("embed", "kv_heads"), "wk")(x)
         v = dense(nkv * hd, ("embed", "kv_heads"), "wv")(x)
+        if (cfg.attention == "flash" and cfg.mesh is None
+                and hd % 128 == 0):
+            # Packed layout: the kernel reads heads as lane offsets from
+            # the projections' natural [B, T, H·D] shape — the [B, H, T, D]
+            # transpose copies (profiled ~5% of the Llama step) never
+            # materialize.
+            from tony_tpu.ops import flash_attention_packed
+            q4 = rope(q.reshape(b, t, nh, hd), positions, cfg.rope_theta,
+                      seq_axis=1)
+            k4 = rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta,
+                      seq_axis=1)
+            if nkv != nh:
+                # GQA still materializes repeated K/V here; a zero-copy
+                # variant would map query head h to kv block h//reps in
+                # the kernel's K/V index maps (and group the dkv grid by
+                # kv head) — deferred until a GQA config is on the bench.
+                reps = nh // nkv
+                k4 = jnp.repeat(k4, reps, axis=2)
+                v4 = jnp.repeat(v.reshape(b, t, nkv, hd), reps, axis=2)
+                v = v4.reshape(b, t, nh * hd)
+            out = flash_attention_packed(
+                q4.reshape(b, t, nh * hd), k4.reshape(b, t, nh * hd), v,
+                nh, causal=True)
+            return dense(cfg.dim, ("heads", "embed"), "wo")(out)
         # [B, T, H·D] → [B, H, T, D]
         q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)

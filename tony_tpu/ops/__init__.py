@@ -9,7 +9,8 @@ reference implementation used for CPU tests and as the autodiff backward.
 """
 
 from tony_tpu.ops.attention import (
-    flash_attention, flash_attention_sharded, reference_attention)
+    flash_attention, flash_attention_packed, flash_attention_sharded,
+    reference_attention)
 
-__all__ = ["flash_attention", "flash_attention_sharded",
-           "reference_attention"]
+__all__ = ["flash_attention", "flash_attention_packed",
+           "flash_attention_sharded", "reference_attention"]
