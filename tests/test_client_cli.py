@@ -241,3 +241,77 @@ def test_client_reports_submit_to_running_latency(tmp_path):
     assert client.all_running_latency_s is not None
     assert 0 < client.all_running_latency_s < 60
     assert "all tasks running" in out.getvalue()
+
+
+def test_client_relaunches_crashed_am(tmp_path):
+    """AM-attempt restart end-to-end (reference: the RM relaunches the AM
+    container up to yarn's am max-attempts): SIGKILL the live AM process;
+    the client relaunches it, the orphaned attempt-1 executors
+    self-terminate on heartbeat loss, and attempt 2's tasks come back
+    RUNNING under the new AM."""
+    import os
+    import signal
+    import threading
+    import time
+
+    from tony_tpu.rpc import RpcClient
+
+    client = TonyClient(TonyConfig(base_props(**{
+        "tony.application.executes": "python forever.py",
+        "tony.am.max-attempts": "2",
+        "tony.task.max-missed-heartbeats": "3",
+    })), src_dir=WORKLOADS, workdir=tmp_path / "jobs", stream=io.StringIO())
+    client.submit()
+    mon = threading.Thread(
+        target=lambda: setattr(client, "exit_code", client.monitor()),
+        daemon=True)
+    mon.start()
+
+    def running_tasks():
+        addr = client._am_address()
+        if addr is None:
+            return []
+        try:
+            with RpcClient(addr, token=client._token(), timeout=2.0) as c:
+                infos = c.call("get_task_infos")
+        except Exception:
+            return []
+        return [i for i in infos if i["status"] == "RUNNING"]
+
+    def wait_for(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = pred()
+            if v:
+                return v
+            time.sleep(0.05)
+        raise TimeoutError(what)
+
+    def executor_pids():
+        out = []
+        for pid_dir in Path("/proc").glob("[0-9]*"):
+            try:
+                cwd = os.readlink(pid_dir / "cwd")
+            except OSError:
+                continue
+            if str(client.job_dir / "containers") in cwd:
+                out.append(int(pid_dir.name))
+        return out
+
+    wait_for(running_tasks, 60, "attempt-1 task never RUNNING")
+    attempt1_pids = set(executor_pids())  # executor + its user child
+    assert attempt1_pids
+    pid1 = client.am_proc.pid
+    os.killpg(pid1, signal.SIGKILL)  # AM + nothing else (executors setsid)
+    wait_for(lambda: client.am_proc.pid != pid1, 30, "AM never relaunched")
+    assert client._am_launches == 2
+    wait_for(running_tasks, 90, "attempt-2 task never RUNNING")
+    # Attempt-1's executor notices the dead AM and self-terminates (user
+    # child included); attempt-2's processes are the only survivors.
+    wait_for(lambda: not (attempt1_pids & set(executor_pids())), 30,
+             f"orphaned attempt-1 processes remain: "
+             f"{attempt1_pids & set(executor_pids())}")
+    client.kill("test done")
+    mon.join(timeout=60)
+    assert not mon.is_alive()
+    assert client.final_status == "KILLED"

@@ -72,6 +72,7 @@ class TonyClient:
         self.stream = stream or sys.stderr
         self.job_dir = self.workdir / self.app_id
         self.am_proc: Optional[subprocess.Popen] = None
+        self._am_launches = 0
         self.final_status: Optional[str] = None
         self.final_message = ""
         self.tensorboard_url: Optional[str] = None
@@ -130,6 +131,11 @@ class TonyClient:
         ``createYarnApplication`` + ``submitApplication``)."""
         self.conf.validate()
         self.stage()
+        self._launch_am()
+        self._log(f"submitted application {self.app_id} "
+                  f"(job dir {self.job_dir})")
+
+    def _launch_am(self) -> None:
         am_log = open(self.job_dir / "am.log", "ab")
         env = dict(os.environ)
         env["PYTHONPATH"] = child_pythonpath(env)
@@ -145,8 +151,7 @@ class TonyClient:
             env=env, stdout=am_log, stderr=subprocess.STDOUT,
             start_new_session=True)
         am_log.close()
-        self._log(f"submitted application {self.app_id} "
-                  f"(job dir {self.job_dir})")
+        self._am_launches += 1
 
     # -- monitoring (reference: monitorApplication poll loop) --------------
     def _am_address(self) -> Optional[str]:
@@ -195,6 +200,41 @@ class TonyClient:
                     break
                 if self.am_proc.poll() is not None \
                         and self._read_final_status() is None:
+                    # AM process died without a verdict. Reference: the RM
+                    # relaunches the AM container up to yarn's am
+                    # max-attempts and the new attempt re-runs the session
+                    # (executors of the dead attempt self-terminate on
+                    # heartbeat loss). Same contract here via
+                    # tony.am.max-attempts.
+                    max_attempts = self.conf.get_int(
+                        conf_mod.AM_MAX_ATTEMPTS, 1)
+                    if self._am_launches < max_attempts:
+                        self._log(
+                            f"AM process exited with "
+                            f"{self.am_proc.returncode} before a final "
+                            f"status; relaunching "
+                            f"(attempt {self._am_launches + 1}"
+                            f"/{max_attempts})")
+                        (self.job_dir / AM_ADDRESS_FILE).unlink(
+                            missing_ok=True)
+                        if client is not None:
+                            client.close()
+                            client = None
+                        # Let the dead attempt's executors notice the AM
+                        # loss and release their resources (chips!) before
+                        # the new attempt spawns its gang — otherwise the
+                        # two attempts double-book the hardware.
+                        hb_s = self.conf.get_int(
+                            conf_mod.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1e3
+                        misses = max(3, self.conf.get_int(
+                            conf_mod.TASK_MAX_MISSED_HEARTBEATS, 25))
+                        grace = min(60.0,
+                                    misses * (max(1.0, hb_s) + hb_s) + 2.0)
+                        self._log(f"waiting {grace:.0f}s for the previous "
+                                  f"attempt's executors to wind down")
+                        time.sleep(grace)
+                        self._launch_am()
+                        continue
                     self.final_status = "FAILED"
                     self.final_message = (
                         f"AM process exited with {self.am_proc.returncode} "

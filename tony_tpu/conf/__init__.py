@@ -48,6 +48,7 @@ TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
 TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.executor.execution-timeout-ms"
 
 AM_RETRY_COUNT = "tony.am.retry-count"                        # gang-restart attempts
+AM_MAX_ATTEMPTS = "tony.am.max-attempts"                      # AM-process relaunches (reference: yarn am max-attempts)
 AM_MEMORY = "tony.am.memory"
 AM_VCORES = "tony.am.vcores"
 AM_GANG_TIMEOUT_MS = "tony.am.gang-allocation-timeout-ms"     # all-registered barrier timeout
@@ -99,6 +100,7 @@ DEFAULTS: Dict[str, str] = {
     TASK_METRICS_INTERVAL_MS: "5000",
     TASK_EXECUTOR_EXECUTION_TIMEOUT_MS: "0",
     AM_RETRY_COUNT: "0",
+    AM_MAX_ATTEMPTS: "1",
     AM_MEMORY: "2g",
     AM_VCORES: "1",
     AM_GANG_TIMEOUT_MS: "120000",

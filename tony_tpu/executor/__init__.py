@@ -43,6 +43,26 @@ from tony_tpu.rpc import ENV_JOB_TOKEN, RpcClient
 from tony_tpu.runtime import TaskContext, get_framework
 
 
+def _proc_descendants(root: int) -> list:
+    """All live descendant pids of ``root``, via one /proc scan (children
+    first is not needed — callers SIGKILL, so order can't race respawn)."""
+    children: Dict[int, list] = {}
+    for p in Path("/proc").glob("[0-9]*"):
+        try:
+            stat = (p / "stat").read_text()
+        except OSError:
+            continue
+        # field 4 (after the parenthesised comm, which may contain spaces)
+        ppid = int(stat.rsplit(")", 1)[1].split()[1])
+        children.setdefault(ppid, []).append(int(p.name))
+    out, stack = [], [root]
+    while stack:
+        for c in children.get(stack.pop(), []):
+            out.append(c)
+            stack.append(c)
+    return out
+
+
 def reserve_port(host: str = "") -> socket.socket:
     """Bind a listening socket on an ephemeral port and keep it open —
     the reference's ServerSocket reservation. Caller closes just before the
@@ -137,6 +157,7 @@ class TaskExecutor:
         self.framework = get_framework(
             self.conf.get(conf_mod.APPLICATION_FRAMEWORK, "jax"))
         self.user_proc: Optional[subprocess.Popen] = None
+        self._am_lost = False
         self._hb_stop = threading.Event()
 
     # -- pieces ------------------------------------------------------------
@@ -201,13 +222,63 @@ class TaskExecutor:
                 paths + [os.environ.get("PATH", "")])
         return out
 
-    def _heartbeat_loop(self, interval_s: float) -> None:
-        while not self._hb_stop.wait(interval_s):
+    def _heartbeat_loop(self, interval_s: float,
+                        max_failures: int = 5) -> None:
+        """Heartbeat to the AM; after ``max_failures`` CONSECUTIVE failed
+        calls the AM is presumed dead and the user process is killed —
+        the container-side half of AM-attempt restart (reference: the NM
+        tears down containers when the application terminates). Without
+        this, an AM crash would orphan executors training forever.
+
+        Uses its own short-timeout RPC client: the shared ``self.client``
+        retries transport errors internally for its full 30s window, which
+        would stretch ``max_failures`` consecutive misses into minutes."""
+        hb_client = RpcClient(self.am_address, token=self.token,
+                              timeout=max(1.0, interval_s))
+        failures = 0
+        try:
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    hb_client.call("heartbeat", job_type=self.job_type,
+                                   index=self.index)
+                    failures = 0
+                except Exception:
+                    failures += 1
+                    if failures < max_failures:
+                        continue
+                    if self._hb_stop.is_set():
+                        return
+                    if not self._am_lost:
+                        print(f"[tony-executor] AM unreachable for "
+                              f"{failures} heartbeats; terminating task",
+                              file=sys.stderr)
+                        self._am_lost = True
+                    if self.user_proc is None:
+                        # Not launched yet (gang barrier / localization):
+                        # run() aborts before launch on _am_lost; keep
+                        # polling in case the launch raced this check.
+                        continue
+                    self._kill_user_proc()
+                    return
+        finally:
+            hb_client.close()
+
+    def _kill_user_proc(self) -> None:
+        """SIGKILL the user process TREE. The command runs via a shell
+        that does not exec (dash keeps `sh -c` as the parent), and user
+        code may fork — killing only the direct child leaves the real
+        workload alive. The tree is walked via /proc rather than killpg:
+        the user proc shares the executor's process group (the scheduler's
+        teardown killpg depends on that), so a group kill would take the
+        executor down with it."""
+        if self.user_proc is None or self.user_proc.poll() is not None:
+            return
+        for pid in _proc_descendants(self.user_proc.pid) + [
+                self.user_proc.pid]:
             try:
-                self.client.call("heartbeat", job_type=self.job_type,
-                                 index=self.index)
-            except Exception:
-                return
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
     def run(self) -> int:
         conf = self.conf
@@ -232,8 +303,11 @@ class TaskExecutor:
         deadline = time.monotonic() + gang_timeout_s
         hb_interval_s = conf.get_int(
             conf_mod.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1e3
+        max_missed = self.conf.get_int(
+            conf_mod.TASK_MAX_MISSED_HEARTBEATS, 25)
         hb_thread = threading.Thread(
-            target=self._heartbeat_loop, args=(hb_interval_s,),
+            target=self._heartbeat_loop,
+            args=(hb_interval_s, max(3, max_missed)),
             daemon=True, name="heartbeat")
         hb_thread.start()
         try:
@@ -267,11 +341,21 @@ class TaskExecutor:
             pypath = [p for p in (cwd, env.get("PYTHONPATH")) if p]
             env["PYTHONPATH"] = os.pathsep.join(pypath)
             # 6. release reserved ports, launch the user process.
+            if self._am_lost:
+                # AM died while we were still in the barrier/localization
+                # phase — launching now would create an unmonitored orphan.
+                print("[tony-executor] AM lost before launch; aborting",
+                      file=sys.stderr)
+                return constants.EXIT_FAILURE
             rendezvous_sock.close()
             if tb_sock is not None:
                 tb_sock.close()
             stdout = open(self.log_dir / constants.USER_STDOUT_NAME, "ab")
             stderr = open(self.log_dir / constants.USER_STDERR_NAME, "ab")
+            # Stays in the executor's process group on purpose: the
+            # scheduler's teardown killpg must keep reaping executor +
+            # user tree together; the executor's own kills walk the tree
+            # (see _kill_user_proc).
             self.user_proc = subprocess.Popen(
                 cmd, shell=True, env=env, cwd=cwd,
                 stdout=stdout, stderr=stderr)
@@ -314,11 +398,19 @@ class TaskExecutor:
                 exit_code = self.user_proc.wait(
                     timeout=timeout_ms / 1e3 if timeout_ms else None)
             except subprocess.TimeoutExpired:
-                self.user_proc.kill()
+                self._kill_user_proc()
                 self.user_proc.wait()
                 exit_code = constants.EXIT_FAILURE
                 diagnostics = f"execution timed out after {timeout_ms}ms"
+            if self._am_lost and not diagnostics:
+                diagnostics = "AM unreachable; task terminated by executor"
             monitor.stop()
+            if self._am_lost:
+                # The AM is gone — reporting would only burn the RPC
+                # client's full retry window before failing anyway.
+                print(f"[tony-executor] skipping result RPC: {diagnostics}",
+                      file=sys.stderr)
+                return exit_code
             try:
                 self.client.call("register_execution_result",
                                  job_type=self.job_type, index=self.index,
@@ -335,8 +427,7 @@ class TaskExecutor:
                         s.close()
                     except OSError:
                         pass
-            if self.user_proc is not None and self.user_proc.poll() is None:
-                self.user_proc.kill()
+            self._kill_user_proc()
             self.client.close()
 
 
@@ -348,8 +439,7 @@ def main() -> int:
         return constants.EXIT_FAILURE
     # Forward SIGTERM (scheduler stop) to the user process so it can die fast.
     def _on_term(signum, frame):
-        if executor.user_proc is not None and executor.user_proc.poll() is None:
-            executor.user_proc.kill()
+        executor._kill_user_proc()
         sys.exit(constants.EXIT_KILLED)
     signal.signal(signal.SIGTERM, _on_term)
     return executor.run()

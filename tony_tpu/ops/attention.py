@@ -519,14 +519,14 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
                               interpret=interpret)
         return out.transpose(0, 2, 1, 3).reshape(b, t, hd)
 
-    if d % 128:
-        _warn_fallback(
-            f"packed layout needs head_dim % 128 == 0, got {d}")
-        return unpacked_fallback()
     if interpret is None:
         if jax.default_backend() != "tpu":
             return unpacked_fallback()
         interpret = False
+    if d % 128:
+        _warn_fallback(
+            f"packed layout needs head_dim % 128 == 0, got {d}")
+        return unpacked_fallback()
     plan, bq, bk, extra = _plan_dispatch(t, tk, block_q, block_k, causal)
     if plan == "kernel":
         return _flash_packed(q, k, v, heads, causal, scale, bq, bk,
