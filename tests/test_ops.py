@@ -203,3 +203,47 @@ def test_flash_packed_bad_head_dim_falls_back():
     np.testing.assert_allclose(
         np.asarray(out.reshape(b, t, h, d).transpose(0, 2, 1, 3)),
         np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_streamed_kv_matches_reference(causal):
+    """Long-context path: force the streamed-KV kernels (k-block grid axis
+    + VMEM scratch accumulators) by shrinking the resident threshold, and
+    check values AND grads against the reference."""
+    from tony_tpu.ops import attention as att
+
+    old = att._RESIDENT_MAX_T
+    att._RESIDENT_MAX_T = 0   # every length takes the streamed kernels
+    try:
+        q, k, v = rand_qkv(b=1, h=2, t=64, d=16)
+        w = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 64, 16))
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal, block_q=16,
+                                    block_k=16, interpret=True) * w).sum()
+
+        def loss_ref(q, k, v):
+            return (reference_attention(q, k, v, causal=causal) * w).sum()
+
+        np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                   float(loss_ref(q, k, v)), rtol=1e-4)
+        g_f = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+        g_r = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for a, b in zip(g_f, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-5)
+
+        # Packed layout through the streamed kernels too.
+        from tony_tpu.ops import flash_attention_packed
+        b_, h_, t_, d_ = 1, 2, 32, 128
+        q2, k2, v2 = rand_qkv(b=b_, h=h_, t=t_, d=d_)
+        pack = lambda x: x.transpose(0, 2, 1, 3).reshape(b_, t_, h_ * d_)
+        out_p = flash_attention_packed(pack(q2), pack(k2), pack(v2), h_,
+                                       causal=causal, block_q=16,
+                                       block_k=16, interpret=True)
+        ref2 = reference_attention(q2, k2, v2, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out_p.reshape(b_, t_, h_, d_).transpose(0, 2, 1, 3)),
+            np.asarray(ref2), atol=2e-5, rtol=2e-5)
+    finally:
+        att._RESIDENT_MAX_T = old

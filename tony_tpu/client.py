@@ -228,11 +228,14 @@ class TonyClient:
                             conf_mod.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1e3
                         misses = max(3, self.conf.get_int(
                             conf_mod.TASK_MAX_MISSED_HEARTBEATS, 25))
-                        grace = min(60.0,
-                                    misses * (max(1.0, hb_s) + hb_s) + 2.0)
+                        # Worst-case executor detection time — NOT capped
+                        # below it: relaunching early double-books chips
+                        # against the dead attempt's still-live executors.
+                        grace = misses * (max(1.0, hb_s) + hb_s) + 2.0
                         self._log(f"waiting {grace:.0f}s for the previous "
                                   f"attempt's executors to wind down")
                         time.sleep(grace)
+                        self._last_status.clear()  # re-log attempt-2 states
                         self._launch_am()
                         continue
                     self.final_status = "FAILED"

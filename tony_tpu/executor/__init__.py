@@ -44,8 +44,9 @@ from tony_tpu.runtime import TaskContext, get_framework
 
 
 def _proc_descendants(root: int) -> list:
-    """All live descendant pids of ``root``, via one /proc scan (children
-    first is not needed — callers SIGKILL, so order can't race respawn)."""
+    """All live descendant pids of ``root``, via one /proc scan. Callers
+    must kill ``root`` before this list so a supervising parent can't
+    respawn children mid-sweep."""
     children: Dict[int, list] = {}
     for p in Path("/proc").glob("[0-9]*"):
         try:
@@ -273,8 +274,12 @@ class TaskExecutor:
         executor down with it."""
         if self.user_proc is None or self.user_proc.poll() is not None:
             return
-        for pid in _proc_descendants(self.user_proc.pid) + [
-                self.user_proc.pid]:
+        # Root FIRST: a supervising parent (e.g. a retry-loop shell) could
+        # otherwise fork a replacement child between the /proc scan and
+        # its own kill; dead parents can't respawn, so the pre-captured
+        # descendant list is then safe to sweep.
+        descendants = _proc_descendants(self.user_proc.pid)
+        for pid in [self.user_proc.pid] + descendants:
             try:
                 os.kill(pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):

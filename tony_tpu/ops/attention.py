@@ -60,7 +60,259 @@ def _causal_mask(s, qi, bq, kb, block_k):
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, causal: bool, scale: float, qi_axis: int = 1):
+    """Streamed-KV flash forward: grid ``(..., qi, kb)`` with the k-block
+    axis INNERMOST, so K/V arrive one ``[Bk, D]`` block at a time (VMEM
+    stays O(block), any context length fits) while the online-softmax
+    state (running max m, normalizer l, accumulator acc) carries across
+    k-steps in VMEM scratch. The q/o/lse blocks keep a constant index over
+    the k axis, so they stay resident and o/lse flush once, written at the
+    last k-step. Causal q-blocks skip the compute (not the schedule) of
+    k-blocks above the diagonal via predication. Also writes the
+    log-sum-exp rows the backward kernels reconstruct p from.
+    ``qi_axis`` is which grid axis carries the q-block index (the k axis
+    is ``qi_axis + 1``): 1 for the [B·H, T, D] layout's (bh, i, kb) grid,
+    2 for the packed [B, T, H·D] layout's (b, h, i, kb) grid."""
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    qi = pl.program_id(qi_axis)
+    kb = pl.program_id(qi_axis + 1)
+    nkb = pl.num_programs(qi_axis + 1)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    contributes = (kb * bk < (qi + 1) * bq) if causal else (kb >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        # Matmul inputs stay in their storage dtype (bf16): bf16×bf16
+        # products are exact in the MXU's f32 accumulator, so this loses
+        # nothing over upcast-then-dot. Softmax math runs in f32; p casts
+        # back for the PV matmul.
+        q = q_ref[:]
+        s = jax.lax.dot_general(
+            q, k_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
+        if causal:
+            s = _causal_mask(s, qi, bq, kb, bk)
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                      (bq, _LSE_LANES))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                         dq_scr, *, causal: bool, scale: float,
+                         qi_axis: int = 1):
+    """dq, streamed like the forward (grid ``(..., qi, kb)``, k innermost,
+    dq accumulated in VMEM scratch): recompute p from (q, k, lse) per
+    k-block — ds = p·(dpᵀ−D); dq += ds·k·scale. No T×T buffer and no
+    full-length K/V ever materialize."""
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    qi = pl.program_id(qi_axis)
+    kb = pl.program_id(qi_axis + 1)
+    nkb = pl.num_programs(qi_axis + 1)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    contributes = (kb * bk < (qi + 1) * bq) if causal else (kb >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[:]
+        do = do_ref[:]
+        D = jnp.sum(do.astype(jnp.float32) * o_ref[:].astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [Bq, 1]
+        lse = lse_ref[:, 0:1]                            # [Bq, 1]
+        s = jax.lax.dot_general(
+            q, k_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, bq, kb, bk)
+        p = jnp.exp(s - lse)                              # exact softmax
+        dp = jax.lax.dot_general(
+            do, v_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - D)).astype(k_ref.dtype)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                          scale: float, qi_axis: int = 1):
+    """dk/dv, streamed: grid ``(..., kj, qb)`` with the q-block axis
+    INNERMOST — q/do/o/lse arrive one block at a time while this k-block's
+    dk/dv accumulate in VMEM scratch (dv += pᵀ·do; dk += dsᵀ·q·scale).
+    Causal k-blocks skip q-blocks strictly above the diagonal."""
+    bk, d = k_ref.shape
+    bq = q_ref.shape[0]
+    kj = pl.program_id(qi_axis)
+    qb = pl.program_id(qi_axis + 1)
+    nqb = pl.num_programs(qi_axis + 1)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    contributes = ((qb + 1) * bq > kj * bk) if causal else (qb >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, 0:1]                             # [Bq, 1]
+        s = jax.lax.dot_general(
+            q, k_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qb, bq, kj, bk)
+        p = jnp.exp(s - lse)                              # [Bq, Bk]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        D = jnp.sum(do.astype(jnp.float32) * o_ref[:].astype(jnp.float32),
+                    axis=-1, keepdims=True)
+        ds = (p * (dp - D)).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qb == nqb - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _fwd_scratch(block_q, d):
+    return [pltpu.VMEM((block_q, _LSE_LANES), jnp.float32),   # m
+            pltpu.VMEM((block_q, _LSE_LANES), jnp.float32),   # l
+            pltpu.VMEM((block_q, d), jnp.float32)]            # acc
+
+
+def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    grid = (b * h, pl.cdiv(t, block_q), pl.cdiv(tk, block_k))
+    qr = q.reshape(b * h, t, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g, i, kb: (g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i, kb: (g, kb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i, kb: (g, kb, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, block_q, d), lambda g, i, kb: (g, i, 0)),
+            pl.BlockSpec((None, block_q, _LSE_LANES),
+                         lambda g, i, kb: (g, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, _LSE_LANES), jnp.float32),
+        ),
+        scratch_shapes=_fwd_scratch(block_q, d),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * t * tk * d // (2 if causal else 1),
+            bytes_accessed=(qr.size + kr.size + vr.size) * q.dtype.itemsize,
+            transcendentals=b * h * t * tk),
+    )(qr, kr, vr)
+    return out.reshape(b, h, t, d), lse   # lse: [b·h, t, _LSE_LANES]
+
+
+def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_k,
+                    interpret):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
+    dor, outr = do.reshape(bh, t, d), o.reshape(bh, t, d)
+    lser = lse                                    # [bh, t, _LSE_LANES]
+    # dq grid: (bh, qi, kb) — k streamed innermost (q-side blocks pinned).
+    q_pin = pl.BlockSpec((None, block_q, d), lambda g, i, kb: (g, i, 0))
+    k_str = pl.BlockSpec((None, block_k, d), lambda g, i, kb: (g, kb, 0))
+    lse_pin = pl.BlockSpec((None, block_q, _LSE_LANES),
+                           lambda g, i, kb: (g, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        grid=(bh, pl.cdiv(t, block_q), pl.cdiv(tk, block_k)),
+        in_specs=[q_pin, k_str, k_str, q_pin, q_pin, lse_pin],
+        out_specs=q_pin,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, outr, lser)
+
+    # dkv grid: (bh, kj, qb) — q-side streamed innermost (k-blocks pinned).
+    k_pin = pl.BlockSpec((None, block_k, d), lambda g, j, qb: (g, j, 0))
+    q_str = pl.BlockSpec((None, block_q, d), lambda g, j, qb: (g, qb, 0))
+    lse_str = pl.BlockSpec((None, block_q, _LSE_LANES),
+                           lambda g, j, qb: (g, qb, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale),
+        grid=(bh, pl.cdiv(tk, block_k), pl.cdiv(t, block_q)),
+        in_specs=[q_str, k_pin, k_pin, q_str, q_str, lse_str],
+        out_specs=(k_pin, k_pin),
+        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, outr, lser)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
+
+
+
+# --------------------------------------------------------------------
+# Resident-KV variants: the whole K/V for one (batch, head) lives in
+# VMEM and the kernel loops k-blocks internally, letting causal grids
+# skip above-diagonal blocks from the SCHEDULE (not just the compute)
+# — measured ~7% faster than the streamed kernels at bench shapes.
+# Only legal while K/V fit VMEM; _RESIDENT_MAX_T gates the dispatch
+# (t=8192 OOMs v5e VMEM, t=4096 fits with headroom).
+# --------------------------------------------------------------------
+
+def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   causal: bool, scale: float, qi_axis: int = 1):
     """One grid cell: q-block [Bq, D] against the full K/V [T, D] in VMEM,
     streamed in block_k chunks through the online-softmax recurrence. Also
@@ -114,7 +366,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[:] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, _LSE_LANES))
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                          *, block_k: int, causal: bool, scale: float,
                          qi_axis: int = 1):
     """dq for one q-block: recompute p from (q, k, lse) per k-block —
@@ -153,7 +405,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                           dk_ref, dv_ref, *, block_q: int, causal: bool,
                           scale: float, qi_axis: int = 1):
     """dk/dv for one k-block: iterate q-blocks (from the diagonal down when
@@ -200,14 +452,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, t, d = q.shape
     tk = k.shape[2]
     grid = (b * h, pl.cdiv(t, block_q))
     qr = q.reshape(b * h, t, d)
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
-    kernel = functools.partial(_flash_kernel, block_k=block_k,
+    kernel = functools.partial(_flash_kernel_resident, block_k=block_k,
                                causal=causal, scale=scale)
     out, lse = pl.pallas_call(
         kernel,
@@ -234,7 +486,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     return out.reshape(b, h, t, d), lse   # lse: [b·h, t, _LSE_LANES]
 
 
-def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
+def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_k,
                     interpret):
     b, h, t, d = q.shape
     tk = k.shape[2]
@@ -250,7 +502,7 @@ def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
     k_spec = pl.BlockSpec((None, block_k, d), lambda g, j: (g, j, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+        functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
                           causal=causal, scale=scale),
         grid=(bh, pl.cdiv(t, block_q)),
         in_specs=[q_spec, kv_full, kv_full, q_spec, q_spec, lse_blk],
@@ -260,7 +512,7 @@ def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
     )(qr, kr, vr, dor, outr, lser)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+        functools.partial(_flash_bwd_dkv_kernel_resident, block_q=block_q,
                           causal=causal, scale=scale),
         grid=(bh, pl.cdiv(tk, block_k)),
         in_specs=[q_full, k_spec, k_spec, q_full, q_full, lse_full],
@@ -272,29 +524,50 @@ def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
     return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
-    return out
+
+# K/V bytes for one (batch, head) must fit VMEM for the resident variants;
+# measured on v5e: t=4096 fits with headroom, t=8192 OOMs VMEM.
+_RESIDENT_MAX_T = 4096
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, out, lse)
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    if k.shape[2] <= _RESIDENT_MAX_T:
+        return _flash_forward_resident(q, k, v, causal, scale, block_q,
+                                       block_k, interpret)
+    return _flash_forward_streamed(q, k, v, causal, scale, block_q,
+                                   block_k, interpret)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v, out, lse = residuals
-    return _flash_backward(q, k, v, g, out, lse, causal, scale, block_q,
-                           block_k, interpret)
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
+def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
+                    interpret):
+    if k.shape[2] <= _RESIDENT_MAX_T:
+        return _flash_backward_resident(q, k, v, do, o, lse, causal, scale,
+                                        block_q, block_k, interpret)
+    return _flash_backward_streamed(q, k, v, do, o, lse, causal, scale,
+                                    block_q, block_k, interpret)
 
 
 def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
+                          interpret):
+    if k.shape[1] <= _RESIDENT_MAX_T:
+        return _flash_forward_packed_resident(q, k, v, heads, causal, scale,
+                                              block_q, block_k, interpret)
+    return _flash_forward_packed_streamed(q, k, v, heads, causal, scale,
+                                          block_q, block_k, interpret)
+
+
+def _flash_backward_packed(q, k, v, do, o, lse, heads, causal, scale,
+                           block_q, block_k, interpret):
+    if k.shape[1] <= _RESIDENT_MAX_T:
+        return _flash_backward_packed_resident(
+            q, k, v, do, o, lse, heads, causal, scale, block_q, block_k,
+            interpret)
+    return _flash_backward_packed_streamed(
+        q, k, v, do, o, lse, heads, causal, scale, block_q, block_k,
+        interpret)
+
+
+def _flash_forward_packed_resident(q, k, v, heads, causal, scale, block_q, block_k,
                           interpret):
     """Forward over the packed [B, T, H·D] layout: grid (b, h, i) with the
     head carried as a lane offset (block index h on the last dim) — no
@@ -303,7 +576,7 @@ def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
     tk = k.shape[1]
     d = hd // heads
     grid = (b, heads, pl.cdiv(t, block_q))
-    kernel = functools.partial(_flash_kernel, block_k=block_k,
+    kernel = functools.partial(_flash_kernel_resident, block_k=block_k,
                                causal=causal, scale=scale, qi_axis=2)
     out, lse = pl.pallas_call(
         kernel,
@@ -331,7 +604,7 @@ def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
     return out, lse
 
 
-def _flash_backward_packed(q, k, v, do, o, lse, heads, causal, scale,
+def _flash_backward_packed_resident(q, k, v, do, o, lse, heads, causal, scale,
                            block_q, block_k, interpret):
     b, t, hd = q.shape
     tk = k.shape[1]
@@ -346,7 +619,7 @@ def _flash_backward_packed(q, k, v, do, o, lse, heads, causal, scale,
     k_spec = pl.BlockSpec((None, block_k, d), lambda bi, h, j: (bi, j, h))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+        functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
                           causal=causal, scale=scale, qi_axis=2),
         grid=(b, heads, pl.cdiv(t, block_q)),
         in_specs=[q_spec, kv_full, kv_full, q_spec, q_spec, lse_blk],
@@ -356,13 +629,124 @@ def _flash_backward_packed(q, k, v, do, o, lse, heads, causal, scale,
     )(q, k, v, do, o, lse)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+        functools.partial(_flash_bwd_dkv_kernel_resident, block_q=block_q,
                           causal=causal, scale=scale, qi_axis=2),
         grid=(b, heads, pl.cdiv(tk, block_k)),
         in_specs=[q_full, k_spec, k_spec, q_full, q_full, lse_full],
         out_specs=(k_spec, k_spec),
         out_shape=(jax.ShapeDtypeStruct((b, tk, hd), k.dtype),
                    jax.ShapeDtypeStruct((b, tk, hd), v.dtype)),
+        interpret=interpret,
+    )(q, k, v, do, o, lse)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, g, out, lse, causal, scale, block_q,
+                           block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_forward_packed_streamed(q, k, v, heads, causal, scale, block_q, block_k,
+                          interpret):
+    """Forward over the packed [B, T, H·D] layout: grid (b, h, i, kb) with
+    the head carried as a lane offset (block index h on the last dim) — no
+    [B, H, T, D] transpose ever materializes. Same streamed kernel body."""
+    b, t, hd = q.shape
+    tk = k.shape[1]
+    d = hd // heads
+    grid = (b, heads, pl.cdiv(t, block_q), pl.cdiv(tk, block_k))
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               qi_axis=2)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d),
+                         lambda bi, h, i, kb: (bi, i, h)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bi, h, i, kb: (bi, kb, h)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda bi, h, i, kb: (bi, kb, h)),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, block_q, d),
+                         lambda bi, h, i, kb: (bi, i, h)),
+            pl.BlockSpec((None, None, block_q, _LSE_LANES),
+                         lambda bi, h, i, kb: (bi, h, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, heads, t, _LSE_LANES), jnp.float32),
+        ),
+        scratch_shapes=_fwd_scratch(block_q, d),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * heads * t * tk * d // (2 if causal else 1),
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=b * heads * t * tk),
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_backward_packed_streamed(q, k, v, do, o, lse, heads, causal, scale,
+                           block_q, block_k, interpret):
+    b, t, hd = q.shape
+    tk = k.shape[1]
+    d = hd // heads
+    # dq grid: (b, h, qi, kb) — k streamed innermost.
+    q_pin = pl.BlockSpec((None, block_q, d),
+                         lambda bi, h, i, kb: (bi, i, h))
+    k_str = pl.BlockSpec((None, block_k, d),
+                         lambda bi, h, i, kb: (bi, kb, h))
+    lse_pin = pl.BlockSpec((None, None, block_q, _LSE_LANES),
+                           lambda bi, h, i, kb: (bi, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
+                          qi_axis=2),
+        grid=(b, heads, pl.cdiv(t, block_q), pl.cdiv(tk, block_k)),
+        in_specs=[q_pin, k_str, k_str, q_pin, q_pin, lse_pin],
+        out_specs=q_pin,
+        out_shape=jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, o, lse)
+
+    # dkv grid: (b, h, kj, qb) — q-side streamed innermost.
+    k_pin = pl.BlockSpec((None, block_k, d),
+                         lambda bi, h, j, qb: (bi, j, h))
+    q_str = pl.BlockSpec((None, block_q, d),
+                         lambda bi, h, j, qb: (bi, qb, h))
+    lse_str = pl.BlockSpec((None, None, block_q, _LSE_LANES),
+                           lambda bi, h, j, qb: (bi, h, qb, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
+                          qi_axis=2),
+        grid=(b, heads, pl.cdiv(tk, block_k), pl.cdiv(t, block_q)),
+        in_specs=[q_str, k_pin, k_pin, q_str, q_str, lse_str],
+        out_specs=(k_pin, k_pin),
+        out_shape=(jax.ShapeDtypeStruct((b, tk, hd), k.dtype),
+                   jax.ShapeDtypeStruct((b, tk, hd), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, o, lse)
     return dq, dk, dv
