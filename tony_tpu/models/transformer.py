@@ -59,15 +59,18 @@ class TransformerConfig:
         return self.dim // self.n_heads
 
     def flops_per_token(self) -> int:
-        """≈6·N_active matmul FLOPs per trained token (fwd+bwd), plus
-        attention's 12·L·dim·seq term — the standard MFU accounting. For
-        MoE, only the top-k experts' FFN params are active per token."""
+        """≈6·N_matmul FLOPs per trained token (fwd+bwd), plus attention's
+        12·L·dim·seq term — matmul-FLOPs-only MFU accounting. The input
+        embedding is a gather (backward: scatter-add) and contributes zero
+        matmul FLOPs, so only the unembed projection counts toward the
+        vocab term. For MoE, only the top-k experts' FFN params are
+        active per token."""
         ffn_active = 3 * self.dim * self.ffn_hidden
         if self.moe_experts > 0:
             ffn_active = (self.moe_top_k * ffn_active
                           + self.dim * self.moe_experts)  # + router
         n_params = (
-            self.vocab * self.dim * 2  # embed + unembed
+            self.vocab * self.dim  # unembed only; embed gather = 0 matmul FLOPs
             + self.n_layers * (
                 self.dim * self.head_dim
                 * (self.n_heads + 2 * self.n_kv_heads)   # wq, wk, wv
