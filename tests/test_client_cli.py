@@ -204,6 +204,80 @@ def test_history_list_show_and_portal(tmp_path):
         server.shutdown()
 
 
+def test_stage_skips_nested_workdir(tmp_path):
+    """`tony submit --src_dir . --workdir ./jobs` puts the workdir INSIDE
+    src_dir; staging must prune it or copytree recurses into the copy
+    being made until ENAMETOOLONG (found live in round 4)."""
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "train.py").write_text("print('hi')\n")
+    client = TonyClient(TonyConfig(base_props()), src_dir=src,
+                        workdir=src / "jobs", stream=io.StringIO())
+    client.stage()
+    staged = client.job_dir / "src"
+    assert (staged / "train.py").is_file()
+    assert not (staged / "jobs").exists()   # the workdir was pruned
+
+    # Degenerate form: --workdir == --src_dir (job dir is a direct child).
+    client2 = TonyClient(TonyConfig(base_props()), src_dir=src,
+                         workdir=src, stream=io.StringIO())
+    client2.stage()
+    staged2 = client2.job_dir / "src"
+    assert (staged2 / "train.py").is_file()
+    assert not (staged2 / client2.app_id).exists()  # job dir pruned
+
+
+def test_history_read_path_is_cached(tmp_path, monkeypatch):
+    """VERDICT r3 #7: a second request over an unchanged history dir must do
+    zero re-parsing (mtime/size-keyed cache), and long TASK_METRICS
+    timelines render downsampled."""
+    from tony_tpu import events as ev
+    from tony_tpu.history import MAX_TIMELINE_SAMPLES
+
+    h = ev.EventHandler(tmp_path, "app_cache_0001", app_name="cached")
+    h.task_started("worker", 0, "127.0.0.1")
+    for i in range(3 * MAX_TIMELINE_SAMPLES):
+        h.task_metrics("worker", 0, {"cpu_pct": float(i)})
+    h.task_finished("worker", 0, "SUCCEEDED", 0)
+    h.application_finished("SUCCEEDED")
+    h.close()
+
+    calls = {"n": 0}
+    real_parse = ev._parse_file
+
+    def counting_parse(path):
+        calls["n"] += 1
+        return real_parse(path)
+
+    monkeypatch.setattr(ev, "_parse_file", counting_parse)
+
+    job = find_job("app_cache_0001", tmp_path)
+    detail = job_detail(job)
+    parses_cold = calls["n"]
+    assert parses_cold >= 1
+
+    # Unchanged dir → both the list scan and the detail page are served
+    # entirely from cache.
+    job2 = find_job("app_cache_0001", tmp_path)
+    detail2 = job_detail(job2)
+    assert calls["n"] == parses_cold
+    assert detail2["final"] == detail["final"]
+
+    # Timeline is downsampled to the cap, newest sample kept.
+    tl = detail["metrics_timelines"]["worker:0"]
+    assert len(tl) == MAX_TIMELINE_SAMPLES
+    assert tl[-1]["cpu_pct"] == float(3 * MAX_TIMELINE_SAMPLES - 1)
+
+    # A changed file (append) invalidates the cache entry.
+    finished = Path(job["path"])
+    with open(finished, "a", encoding="utf-8") as f:
+        f.write(json.dumps({"type": "TASK_METRICS", "timestamp": 0.0,
+                            "payload": {"job_type": "worker", "index": 0,
+                                        "metrics": {"cpu_pct": -1.0}}}) + "\n")
+    job_detail(find_job("app_cache_0001", tmp_path))
+    assert calls["n"] == parses_cold + 1
+
+
 # -- proxy -----------------------------------------------------------------
 
 def test_proxy_roundtrip():

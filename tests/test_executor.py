@@ -1,0 +1,70 @@
+"""Executor-side unit tests (reference tier: TaskExecutor/TaskMonitor unit
+tests, SURVEY.md §4). The full lifecycle is covered by the MiniPod e2e tier;
+these pin the pieces with failure modes too narrow to stage end-to-end."""
+
+import os
+import threading
+import time
+
+from tony_tpu.executor import TaskMonitor
+from tony_tpu.rpc import RpcClient
+
+
+class FlakyClient:
+    """metrics_report sink that fails its first ``fail_first`` calls —
+    a transient AM outage (e.g. an AM-relaunch window)."""
+
+    def __init__(self, fail_first: int):
+        self.fail_first = fail_first
+        self.calls = 0
+        self.delivered = []
+        self.got_samples = threading.Event()
+
+    def call(self, method, **params):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise ConnectionError("AM unreachable (simulated)")
+        self.delivered.append(params["metrics"])
+        if len(self.delivered) >= 2:
+            self.got_samples.set()
+
+
+def test_task_monitor_survives_transient_rpc_failures():
+    """VERDICT r3 #6: a failed metrics RPC must not kill the monitor —
+    after the AM comes back, samples flow again."""
+    client = FlakyClient(fail_first=3)
+    mon = TaskMonitor(os.getpid(), client, "worker", 0, interval_s=0.02)
+    mon.start()
+    try:
+        assert client.got_samples.wait(timeout=20), (
+            f"no samples after AM recovery; {client.calls} calls, "
+            f"{len(client.delivered)} delivered")
+    finally:
+        mon.stop()
+    assert client.calls >= 5  # the 3 failures were retried through, not fatal
+
+
+def test_task_monitor_backoff_resets_on_success():
+    client = FlakyClient(fail_first=2)
+    mon = TaskMonitor(os.getpid(), client, "worker", 0, interval_s=0.02)
+    # Drive _run's loop logic synchronously via sample+call to keep the
+    # timing assertion deterministic: after a success the wait interval
+    # must drop back to the configured cadence.
+    mon.start()
+    try:
+        assert client.got_samples.wait(timeout=20)
+        n = len(client.delivered)
+        time.sleep(0.5)
+        # ≥ a handful of new samples in 0.5s proves backoff was reset
+        # (stuck backoff would cap this near 0.5/interval_backoff ≈ 1).
+        assert len(client.delivered) - n >= 3
+    finally:
+        mon.stop()
+
+
+def test_rpc_client_worst_case_call_bound():
+    """The client's AM-relaunch grace is derived from this bound; it must
+    dominate the retry window plus one last blocking connect+recv."""
+    assert RpcClient.worst_case_call_s(1.0) == 1.0 + 2.0 * 1.0
+    # Long-timeout clients stay capped at the socket timeout per op.
+    assert RpcClient.worst_case_call_s(60.0) == 60.0 + 2.0 * 10.0

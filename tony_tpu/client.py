@@ -107,7 +107,20 @@ class TonyClient:
                 raise FileNotFoundError(f"--src_dir {self.src_dir} not found")
             dest = self.job_dir / "src"
             if not dest.exists():
-                shutil.copytree(self.src_dir, dest)
+                # The workdir may live INSIDE src_dir (e.g. `tony submit
+                # --src_dir . --workdir ./jobs`): copying it would recurse
+                # into the copy being made until ENAMETOOLONG. Prune any
+                # entry that is (or contains) the job workdir.
+                job_root = self.job_dir.resolve()
+                skip = {job_root, job_root.parent}  # job dir AND workdir:
+                # --workdir . makes workdir_root == src_dir (never a child
+                # entry), but the job dir itself then is one.
+
+                def _skip_workdir(path, names):
+                    p = Path(path)
+                    return [n for n in names if (p / n).resolve() in skip]
+
+                shutil.copytree(self.src_dir, dest, ignore=_skip_workdir)
         # Stage the venv (dir or archive) next to the job, like the
         # reference's HDFS venv upload; executors localize per container.
         venv = self.conf.get(conf_mod.PYTHON_VENV)
@@ -231,7 +244,14 @@ class TonyClient:
                         # Worst-case executor detection time — NOT capped
                         # below it: relaunching early double-books chips
                         # against the dead attempt's still-live executors.
-                        grace = misses * (max(1.0, hb_s) + hb_s) + 2.0
+                        # Each missed heartbeat costs up to the RPC client's
+                        # worst-case call time (retry window + a last
+                        # attempt's socket connect+recv — an unreachable
+                        # host blackholes, it doesn't refuse) plus the
+                        # inter-beat wait.
+                        per_call = RpcClient.worst_case_call_s(
+                            max(1.0, hb_s))
+                        grace = misses * (per_call + hb_s) + 2.0
                         self._log(f"waiting {grace:.0f}s for the previous "
                                   f"attempt's executors to wind down")
                         time.sleep(grace)

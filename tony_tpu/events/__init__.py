@@ -130,8 +130,19 @@ class EventHandler:
 # Read path (consumed by the history server and by tests)
 # ---------------------------------------------------------------------------
 
-def read_events(path: str | Path) -> List[Dict[str, Any]]:
-    """Parse one jhist (or .inprogress) file into its event records."""
+# Parse cache keyed by (mtime_ns, size): finished jhists are immutable and
+# in-progress ones only append, so an unchanged stat means an unchanged
+# parse. The reference keeps an in-memory cache with a refresh thread in the
+# history server (SURVEY.md §3.5); stat-on-read gives the same zero-reparse
+# behavior without a thread, and TASK_METRICS growth (one record per task
+# per 5s) makes re-parsing per page hit O(job runtime) without it.
+_CACHE_MAX_FILES = 512
+_parse_cache: Dict[str, tuple] = {}   # path -> (mtime_ns, size, records)
+_meta_cache: Dict[str, tuple] = {}    # path -> (mtime_ns, metadata)
+_parse_cache_lock = threading.Lock()
+
+
+def _parse_file(path: str | Path) -> List[Dict[str, Any]]:
     out = []
     with open(path, encoding="utf-8") as f:
         for line in f:
@@ -141,12 +152,66 @@ def read_events(path: str | Path) -> List[Dict[str, Any]]:
     return out
 
 
+def read_events(path: str | Path) -> List[Dict[str, Any]]:
+    """Parse one jhist (or .inprogress) file into its event records.
+    Cached on (mtime, size); callers must not mutate the returned records."""
+    key = str(path)
+    try:
+        st = os.stat(path)
+    except OSError:
+        # e.g. intermediate→finished rename raced the scan; no stale cache.
+        with _parse_cache_lock:
+            _parse_cache.pop(key, None)
+        raise
+    with _parse_cache_lock:
+        hit = _parse_cache.get(key)
+        if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+            return hit[2]
+    records = _parse_file(path)
+    with _parse_cache_lock:
+        if len(_parse_cache) >= _CACHE_MAX_FILES:
+            # Drop the oldest insertion — plain dicts iterate in insertion
+            # order; good enough for a bound, no LRU bookkeeping needed.
+            _parse_cache.pop(next(iter(_parse_cache)))
+        _parse_cache[key] = (st.st_mtime_ns, st.st_size, records)
+    return records
+
+
 def job_metadata(path: str | Path) -> Dict[str, Any]:
-    """The metadata record (first line) of a jhist file."""
+    """The metadata record (first line) of a jhist file. Served from the
+    parse cache when the file is already cached; reads only the first line
+    otherwise (the list page must not force full parses of every job)."""
+    key = str(path)
+    try:
+        st = os.stat(path)
+    except OSError:
+        st = None
+    if st is not None:
+        with _parse_cache_lock:
+            hit = _parse_cache.get(key)
+            if hit is not None and hit[0] == st.st_mtime_ns \
+                    and hit[1] == st.st_size:
+                recs = hit[2]
+                if recs and recs[0].get("type") == _METADATA:
+                    return recs[0].get("payload", {})
+                return {}
+    if st is not None:
+        with _parse_cache_lock:
+            hit = _meta_cache.get(key)
+            if hit is not None and hit[0] == st.st_mtime_ns:
+                return hit[1]
     with open(path, encoding="utf-8") as f:
         first = f.readline().strip()
     rec = json.loads(first) if first else {}
-    return rec.get("payload", {}) if rec.get("type") == _METADATA else {}
+    meta = rec.get("payload", {}) if rec.get("type") == _METADATA else {}
+    if st is not None:
+        with _parse_cache_lock:
+            if len(_meta_cache) >= _CACHE_MAX_FILES:
+                _meta_cache.pop(next(iter(_meta_cache)))
+            # mtime alone suffices: the metadata line is written once at
+            # file creation and never rewritten.
+            _meta_cache[key] = (st.st_mtime_ns, meta)
+    return meta
 
 
 def list_jobs(history_dir: str | Path) -> Iterator[Dict[str, Any]]:

@@ -123,15 +123,22 @@ class TaskMonitor:
         }
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # A failed report must not kill the monitor: during an AM-relaunch
+        # window every metrics RPC fails transiently, and dying here would
+        # silence metrics for the rest of the job (the heartbeat loop
+        # tolerates the same outage). Back off exponentially while the AM
+        # is unreachable, resume the normal cadence on the first success.
+        backoff = 0.0
+        while not self._stop.wait(self.interval_s + backoff):
             m = self.sample()
             if m is None:
-                return
+                return  # user process exited; nothing left to sample
             try:
                 self.client.call("metrics_report", job_type=self.job_type,
                                  index=self.index, metrics=m)
+                backoff = 0.0
             except Exception:
-                return
+                backoff = min(60.0, max(self.interval_s, backoff * 2))
 
     def stop(self) -> None:
         self._stop.set()
@@ -243,6 +250,14 @@ class TaskExecutor:
                     hb_client.call("heartbeat", job_type=self.job_type,
                                    index=self.index)
                     failures = 0
+                    if self._am_lost and self.user_proc is None:
+                        # The AM was only transiently unreachable (e.g. a
+                        # relaunch window) and recovered before launch —
+                        # un-stick the flag so run() doesn't abort a task
+                        # whose AM is demonstrably alive again.
+                        print("[tony-executor] AM reachable again before "
+                              "launch; resuming", file=sys.stderr)
+                        self._am_lost = False
                 except Exception:
                     failures += 1
                     if failures < max_failures:
@@ -276,14 +291,24 @@ class TaskExecutor:
             return
         # Root FIRST: a supervising parent (e.g. a retry-loop shell) could
         # otherwise fork a replacement child between the /proc scan and
-        # its own kill; dead parents can't respawn, so the pre-captured
-        # descendant list is then safe to sweep.
-        descendants = _proc_descendants(self.user_proc.pid)
-        for pid in [self.user_proc.pid] + descendants:
-            try:
-                os.kill(pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+        # its own kill; dead parents can't respawn. A supervisor DEEPER in
+        # the tree can still fork between the scan and its own kill, so
+        # re-scan and sweep until no new live descendants appear (bounded:
+        # each pass only finds children of processes killed in the prior
+        # pass, so the tree depth bounds the real iteration count).
+        root = self.user_proc.pid
+        targets = [root] + _proc_descendants(root)
+        killed: set = set()
+        for _ in range(5):
+            for pid in targets:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                killed.add(pid)
+            targets = [p for p in _proc_descendants(root) if p not in killed]
+            if not targets:
+                break
 
     def run(self) -> int:
         conf = self.conf

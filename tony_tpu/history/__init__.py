@@ -58,6 +58,23 @@ def find_job(app_id: str,
     return None
 
 
+# Cap on rendered TASK_METRICS samples per task: a long job appends one
+# sample per task per 5s, and rendering all of them makes the detail page
+# O(runtime). Downsampled evenly, always keeping the newest sample.
+MAX_TIMELINE_SAMPLES = 256
+
+
+def _downsample(samples: List[Dict[str, Any]],
+                limit: int = MAX_TIMELINE_SAMPLES) -> List[Dict[str, Any]]:
+    n = len(samples)
+    if n <= limit:
+        return samples
+    step = n / limit
+    picked = [samples[min(n - 1, int(i * step))] for i in range(limit - 1)]
+    picked.append(samples[-1])
+    return picked
+
+
 def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
     """Parsed view of one job: metadata, final status, per-task rows, events
     (reference: JobDetailPageController's model assembly)."""
@@ -76,8 +93,14 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
             tid = f"{p['job_type']}:{p['index']}"
             timelines.setdefault(tid, []).append(
                 {"timestamp": r["timestamp"], **(p.get("metrics") or {})})
+    timelines = {tid: _downsample(samples)
+                 for tid, samples in timelines.items()}
     all_running = next((r for r in records
                         if r["type"] == ev.ALL_TASKS_RUNNING), None)
+    # Collected profiler traces live next to the jhist tree:
+    # <history>/traces/<app_id>/<task>/... (SURVEY.md §5.1 collection half).
+    from tony_tpu.profiler import list_traces
+    history_root = Path(job["path"]).parent.parent
     return {
         "app_id": job["app_id"],
         "state": job["state"],
@@ -85,6 +108,7 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
         "final": final,
         "tasks": tasks,
         "metrics_timelines": timelines,
+        "traces": list_traces(history_root, job["app_id"]),
         "submit_to_running_s": (all_running or {}).get(
             "payload", {}).get("submit_to_running_s"),
         "events": records,
@@ -131,6 +155,11 @@ def render_show(detail: Dict[str, Any]) -> str:
             out.append(f"    {t['job_type']}:{t['index']} {t['status']} "
                        f"exit={t.get('exit_code')}{mstr}"
                        + (f" — {t['diagnostics']}" if t.get("diagnostics") else ""))
+    if detail.get("traces"):
+        out.append("  traces:")
+        for tid, files in sorted(detail["traces"].items()):
+            total = sum(f["bytes"] for f in files)
+            out.append(f"    {tid}: {len(files)} file(s), {total} bytes")
     out.append("  events:")
     for r in detail["events"]:
         when = time.strftime("%H:%M:%S", time.localtime(r["timestamp"]))
@@ -204,6 +233,18 @@ def _job_page(detail: Dict[str, Any]) -> str:
                 parts.append(f"<tr><td>{when}</td>"
                              f"<td>{html.escape(vals)}</td></tr>")
             parts.append("</table>")
+    if detail.get("traces"):
+        parts.append("<h3>Profiler traces</h3><table><tr><th>task</th>"
+                     "<th>file</th><th>bytes</th></tr>")
+        for tid, files in sorted(detail["traces"].items()):
+            for f in files:
+                parts.append(f"<tr><td>{html.escape(tid)}</td>"
+                             f"<td><code>{html.escape(str(f['file']))}</code>"
+                             f"</td><td>{f['bytes']}</td></tr>")
+        parts.append("</table><p>open with: <code>tensorboard --logdir "
+                     "&lt;history&gt;/traces/"
+                     + html.escape(detail['app_id']) + "/&lt;task&gt;</code>"
+                     "</p>")
     parts.append("<h3>Events</h3><table><tr><th>time</th>"
                  "<th>type</th><th>payload</th></tr>")
     for r in detail["events"]:

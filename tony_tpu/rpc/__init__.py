@@ -129,9 +129,26 @@ class RpcClient:
         self._file = None
         self._lock = threading.Lock()
 
+    # Per-operation socket timeout cap. Individual connect/recv calls are
+    # additionally capped by the client's own retry window so that a
+    # short-timeout client (the executor's heartbeat probe) fails FAST when
+    # the AM host is unreachable rather than refusing — an unreachable host
+    # blackholes SYNs and a bare connect would block the full 10s.
+    SOCKET_TIMEOUT_S = 10.0
+
+    @classmethod
+    def worst_case_call_s(cls, timeout: float) -> float:
+        """Upper bound on one :meth:`call`'s wall time: the retry window,
+        plus one last attempt begun just before the deadline that blocks
+        for a full socket connect + recv. The client's AM-relaunch grace
+        is derived from this — keep it in sync with call()/_connect()."""
+        per_op = min(cls.SOCKET_TIMEOUT_S, max(0.1, timeout))
+        return timeout + 2.0 * per_op
+
     def _connect(self) -> None:
         self.close()
-        self._sock = socket.create_connection(self._addr, timeout=10.0)
+        per_op = min(self.SOCKET_TIMEOUT_S, max(0.1, self.timeout))
+        self._sock = socket.create_connection(self._addr, timeout=per_op)
         self._file = self._sock.makefile("rwb")
 
     def call(self, method: str, **params: Any) -> Any:
@@ -285,6 +302,11 @@ class ApplicationRpcHandler:
     # -- client-facing verbs ----------------------------------------------
     def rpc_get_task_infos(self) -> list:
         return self.session.task_infos()
+
+    def rpc_get_task_callback_info(self) -> Dict[str, str]:
+        """The per-task pushed callback payloads (e.g. profiler endpoints) —
+        consumed by ``tony profile`` to find live trace servers."""
+        return dict(self.session.task_callback_info)
 
     def rpc_get_job_status(self) -> Dict[str, Any]:
         return {
