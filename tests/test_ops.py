@@ -212,8 +212,8 @@ def test_flash_streamed_kv_matches_reference(causal):
     check values AND grads against the reference."""
     from tony_tpu.ops import attention as att
 
-    old = att._RESIDENT_MAX_T
-    att._RESIDENT_MAX_T = 0   # every length takes the streamed kernels
+    old = att._RESIDENT_KV_BYTES
+    att._RESIDENT_KV_BYTES = 0   # every shape takes the streamed kernels
     try:
         q, k, v = rand_qkv(b=1, h=2, t=64, d=16)
         w = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 64, 16))
@@ -246,4 +246,4 @@ def test_flash_streamed_kv_matches_reference(causal):
             np.asarray(out_p.reshape(b_, t_, h_, d_).transpose(0, 2, 1, 3)),
             np.asarray(ref2), atol=2e-5, rtol=2e-5)
     finally:
-        att._RESIDENT_MAX_T = old
+        att._RESIDENT_KV_BYTES = old

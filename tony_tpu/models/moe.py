@@ -63,7 +63,12 @@ def router_assignment(gates: jax.Array, top_k: int, capacity: int):
         dispatch = dispatch + hot
         gate = (gates * onehot).sum(-1)                        # [G, S]
         combine = combine + gate[..., None, None] * hot
-        remaining = remaining * (1.0 - onehot)
+        # Exclude chosen experts with -inf, not by multiplying to zero: if
+        # a token's remaining probabilities all underflowed to 0, argmax
+        # would tie-break to expert 0 and could re-select an already-chosen
+        # expert (double-booking its capacity). -inf can never win argmax
+        # while any un-chosen expert remains.
+        remaining = jnp.where(onehot > 0, -jnp.inf, remaining)
     # Renormalize combine weights over the k selected experts so the output
     # is a convex mixture (dropped tokens keep weight 0 → pure residual).
     total = combine.sum(axis=(2, 3), keepdims=True)

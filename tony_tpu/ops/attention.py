@@ -525,13 +525,20 @@ def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_
 
 
 
-# K/V bytes for one (batch, head) must fit VMEM for the resident variants;
-# measured on v5e: t=4096 fits with headroom, t=8192 OOMs VMEM.
-_RESIDENT_MAX_T = 4096
+# One (batch, head)'s K/V must fit VMEM for the resident variants. The
+# budget is in BYTES, not sequence length: VMEM use scales with
+# tk·d·itemsize, so a fixed max-T gate (round 3) would OOM below it for
+# head_dim>128 or f32 inputs. Calibrated on v5e at the measured boundary —
+# t=4096·d=128·bf16 (1 MiB per tensor) fits with headroom, t=8192 OOMs.
+_RESIDENT_KV_BYTES = 4096 * 128 * 2
+
+
+def _resident_fits(tk: int, d: int, dtype) -> bool:
+    return tk * d * jnp.dtype(dtype).itemsize <= _RESIDENT_KV_BYTES
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
-    if k.shape[2] <= _RESIDENT_MAX_T:
+    if _resident_fits(k.shape[2], k.shape[3], k.dtype):
         return _flash_forward_resident(q, k, v, causal, scale, block_q,
                                        block_k, interpret)
     return _flash_forward_streamed(q, k, v, causal, scale, block_q,
@@ -540,7 +547,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
                     interpret):
-    if k.shape[2] <= _RESIDENT_MAX_T:
+    if _resident_fits(k.shape[2], k.shape[3], k.dtype):
         return _flash_backward_resident(q, k, v, do, o, lse, causal, scale,
                                         block_q, block_k, interpret)
     return _flash_backward_streamed(q, k, v, do, o, lse, causal, scale,
@@ -549,7 +556,7 @@ def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
 
 def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
                           interpret):
-    if k.shape[1] <= _RESIDENT_MAX_T:
+    if _resident_fits(k.shape[1], k.shape[2] // heads, k.dtype):
         return _flash_forward_packed_resident(q, k, v, heads, causal, scale,
                                               block_q, block_k, interpret)
     return _flash_forward_packed_streamed(q, k, v, heads, causal, scale,
@@ -558,7 +565,7 @@ def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
 
 def _flash_backward_packed(q, k, v, do, o, lse, heads, causal, scale,
                            block_q, block_k, interpret):
-    if k.shape[1] <= _RESIDENT_MAX_T:
+    if _resident_fits(k.shape[1], k.shape[2] // heads, k.dtype):
         return _flash_backward_packed_resident(
             q, k, v, do, o, lse, heads, causal, scale, block_q, block_k,
             interpret)
