@@ -152,12 +152,16 @@ def test_preemption_relaunches_task(pod):
     victim = job.session.task("worker", 0)
     assert job.scheduler.preempt(victim.container_id)
     # Task must come back: re-registered and RUNNING again, retry counted.
+    # Generous deadline: relaunch = process spawn + re-registration + gang
+    # barrier, which under CPU contention (parallel suite runs) can take
+    # far longer than the idle-machine norm — the assertion is about the
+    # relaunch happening, not how fast.
     job.wait_for(lambda: victim.preemption_retries == 1
                  and victim.status is TaskStatus.RUNNING,
-                 what="preempted task relaunched")
+                 timeout=120, what="preempted task relaunched")
     assert job.session.job_status is JobStatus.RUNNING
     job.kill()
-    assert job.wait(timeout=30) == 1
+    assert job.wait(timeout=60) == 1
     assert job.session.job_status is JobStatus.KILLED
 
 
@@ -364,6 +368,49 @@ def test_tf_config_contract_e2e(pod):
     assert chief_cfg["task"]["type"] == "chief"
     # All members agree on the cluster map.
     assert chief_cfg["cluster"] == tf_config["cluster"]
+
+
+def test_tf_mwms_real_training_e2e(pod):
+    """VERDICT r3 #3 / graduation config ②: REAL tf.distribute training —
+    MultiWorkerMirroredStrategy forms its collective ring from the injected
+    TF_CONFIG across 2 containers and the loss decreases."""
+    job = pod.run(props(**{
+        "tony.application.framework": "tensorflow",
+        "tony.worker.instances": "2",
+        "tony.application.executes": wl("tf_mwms_train.py"),
+        "tony.task.max-missed-heartbeats": "200",   # TF import is slow
+    }), src_dir=WORKLOADS, timeout=300)
+    for t in job.session.tasks():
+        assert t.status is TaskStatus.SUCCEEDED, (t.task_id, t.diagnostics)
+    results = sorted(Path(job.am.job_dir).glob(
+        "containers/*/src/tf_rank*.json"))
+    assert len(results) == 2
+    for p in results:
+        data = json.loads(p.read_text())
+        assert data["n_workers"] == 2
+        assert data["loss_last"] < data["loss_first"] * 0.5
+
+
+def test_tf_ps_strategy_real_training_e2e(pod):
+    """VERDICT r3 #3 / graduation config ①: REAL ParameterServerStrategy —
+    ps+worker run tf.distribute.Servers, the chief's ClusterCoordinator
+    trains through them, chief-done policy ends the job."""
+    job = pod.run(props(**{
+        "tony.application.framework": "tensorflow",
+        "tony.chief.instances": "1",
+        "tony.ps.instances": "1",
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("tf_ps_train.py"),
+        # worker runs a server forever; only the chief's exit decides.
+        "tony.application.untracked.jobtypes": "ps,worker",
+        "tony.task.max-missed-heartbeats": "200",
+    }), src_dir=WORKLOADS, timeout=300)
+    assert job.exit_code == 0, job.session.final_message
+    assert job.session.task("chief", 0).status is TaskStatus.SUCCEEDED
+    [result] = Path(job.am.job_dir).glob(
+        "containers/*/src/tf_ps_result.json")
+    data = json.loads(result.read_text())
+    assert data["loss_last"] < data["loss_first"] * 0.5
 
 
 def test_pytorch_ddp_example_e2e(pod):
@@ -588,6 +635,34 @@ def test_tpuvm_staging_failure_fails_job_not_am(tpuvm):
     assert "staging" in diags and "failed" in diags
 
 
+def test_tpuvm_jax_distributed_dp_training(tpuvm):
+    """VERDICT r3 #4: the closest this environment gets to the v4-32 story —
+    two 'hosts' behind the SSH substrate run REAL jax.distributed DP
+    training end to end: tar-over-ssh staging, remote env rewrite, the
+    jax coordinator formed across 'hosts', GSPMD grad psum, and a clean
+    remote teardown with zero orphans."""
+    job = tpuvm.pod.run(tpuvm.props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "2",
+        "tony.application.executes": wl("jax_dp_train.py"),
+        "tony.am.gang-allocation-timeout-ms": "120000",
+        "tony.task.max-missed-heartbeats": "100",  # slow CPU compile
+    }), src_dir=WORKLOADS, timeout=240)
+    for t in job.session.tasks():
+        assert t.status is TaskStatus.SUCCEEDED, (t.task_id, t.diagnostics)
+    assert job.exit_code == 0
+    # Placement spanned both 'hosts' (the coordinator crossed the
+    # substrate): the REGISTERED executor hosts, not the scheduler's
+    # pre-populated host table.
+    assert {t.host for t in job.session.tasks()} == \
+        {"127.0.0.1", "localhost"}
+    data = json.loads((tpuvm.remote / "src" / "dp_losses.json").read_text())
+    assert data["num_processes"] == 2
+    assert data["losses"][-1] < data["losses"][0]
+    assert not tpuvm.orphaned_executors()
+    assert not list((tpuvm.remote / "pids").glob("*.pid"))
+
+
 def test_metrics_timeline_and_latency_events(pod, monkeypatch):
     """VERDICT r2 #5/#8: TaskMonitor samples must survive as a TASK_METRICS
     timeline in the jhist (not just the final snapshot), and the gang
@@ -645,6 +720,36 @@ def test_callback_info_dispatched_to_am(pod):
     assert "worker:0" in info
     payload = json.loads(info["worker:0"])
     assert payload["profiler"].endswith(":9431")  # port-base + rank 0
+
+
+def test_profiler_trace_collection(pod):
+    """VERDICT r3 #5: the collection half of SURVEY §5.1 — the AM fetches a
+    real trace from each rank's profiler endpoint into the history dir,
+    and the portal lists it."""
+    from tony_tpu.history import job_detail, render_show, _job_page
+    from tony_tpu.profiler import list_traces
+
+    job = pod.run(props(**{
+        "tony.application.framework": "jax",
+        "tony.worker.instances": "1",
+        "tony.application.executes": wl("profiled_train.py"),
+        "tony.task.profiler.enabled": "true",
+        "tony.task.profiler.collect-after-s": "0.5",
+        "tony.task.profiler.collect-duration-ms": "1000",
+    }), src_dir=WORKLOADS, timeout=180)
+    assert job.exit_code == 0, job.session.final_message
+    history = Path(job.am.job_dir) / "history"
+    traces = list_traces(history, job.am.app_id)
+    assert "worker_0" in traces, f"no trace collected: {traces}"
+    assert any(f["bytes"] > 0 and str(f["file"]).endswith(".xplane.pb")
+               for f in traces["worker_0"]), traces["worker_0"]
+    # Portal surfaces: the show page and the HTML job page list the trace.
+    [jhist] = (history / "finished").glob("*.jhist")
+    detail = job_detail({"app_id": job.am.app_id, "state": "finished",
+                         "path": str(jhist), "metadata": {}})
+    assert detail["traces"] == traces
+    assert "traces:" in render_show(detail)
+    assert "Profiler traces" in _job_page(detail)
 
 
 def test_checkpoint_resume_across_gang_restart(pod, tmp_path):

@@ -1,0 +1,25 @@
+"""Worker that starts the profiler server (via tony_tpu.distributed) and
+keeps the backend busy long enough for the AM's automatic trace collection
+to capture real events (SURVEY.md §5.1 collection half, e2e)."""
+
+import os
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import tony_tpu.distributed as dist
+
+dist.initialize()  # starts jax.profiler.start_server on TONY_PROFILER_PORT
+assert os.environ.get("TONY_PROFILER_PORT"), "profiler port not assigned"
+
+import jax.numpy as jnp
+
+x = jnp.ones((256, 256))
+deadline = time.time() + 25.0
+while time.time() < deadline:
+    x = (x @ x) / 256.0
+    x.block_until_ready()
+print("profiled workload done")

@@ -24,6 +24,7 @@ import json
 import os
 import secrets
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Dict, Optional
@@ -99,6 +100,7 @@ class ApplicationMaster:
         self._containers: Dict[str, Container] = {}   # task_id -> live container
         self.final_status = JobStatus.FAILED
         self.final_message = ""
+        self.history_dir: Optional[Path] = None       # set in run()
         self._stop_reason: Optional[str] = None       # set by request_stop
 
     def _log(self, msg: str) -> None:
@@ -230,6 +232,41 @@ class ApplicationMaster:
                     c.exit_code if c.exit_code else constants.EXIT_FAILURE,
                     f"executor exited with {c.exit_code} without reporting")
 
+    def _collect_traces_later(self, session: TonySession,
+                              delay_s: float) -> None:
+        """Wait for the executors' profiler endpoints to arrive (they're
+        pushed after user-process launch, i.e. after the gang barrier),
+        let the workload settle for ``delay_s``, then capture one trace
+        per rank into ``<history>/traces/<app_id>/``."""
+        from tony_tpu import profiler
+
+        deadline = time.monotonic() + 120.0
+        endpoints: Dict[str, str] = {}
+        while time.monotonic() < deadline and not session.is_done():
+            endpoints = profiler.endpoints_from_callback_info(
+                session.task_callback_info)
+            if endpoints:
+                break
+            time.sleep(0.25)
+        if not endpoints:
+            self._log("trace collection: no profiler endpoints appeared")
+            return
+        time.sleep(delay_s)
+        if session.is_done():
+            return
+        # Re-read after the settle sleep: ranks whose executors pushed
+        # their endpoint later than the first one (slow import, another
+        # host) must not be excluded from the synchronized session.
+        endpoints = profiler.endpoints_from_callback_info(
+            session.task_callback_info) or endpoints
+        duration_ms = self.conf.get_int(
+            "tony.task.profiler.collect-duration-ms", 2000)
+        assert self.history_dir is not None
+        profiler.collect_traces(
+            endpoints, self.history_dir, self.app_id,
+            duration_ms=duration_ms,
+            log=lambda *a, **k: self._log(" ".join(str(x) for x in a)))
+
     # -- one attempt -------------------------------------------------------
     def run_attempt(self, attempt_id: int) -> JobStatus:
         conf = self.conf
@@ -261,6 +298,15 @@ class ApplicationMaster:
                       + (f" ({latency:.2f}s after submit)" if latency else ""))
             if self.events is not None:
                 self.events.all_running(session.attempt_id, latency)
+            # AM-side automatic trace collection (SURVEY.md §5.1): one
+            # capture from every rank's profiler endpoint, N seconds after
+            # the endpoints appear, into the history dir next to the jhist.
+            collect_after = conf.get("tony.task.profiler.collect-after-s")
+            if collect_after is not None and self.history_dir is not None:
+                threading.Thread(
+                    target=self._collect_traces_later,
+                    args=(session, float(collect_after)),
+                    daemon=True, name="trace-collect").start()
 
         handler.on_all_registered = on_all_registered
         handler.on_callback_info = am_adapter.receive_task_callback_info
@@ -353,6 +399,7 @@ class ApplicationMaster:
         conf.save(self.job_dir / constants.TONY_JOB_JSON)
         history = conf.get(conf_mod.HISTORY_LOCATION) or str(
             self.job_dir / "history")
+        self.history_dir = Path(history)
         self.events = EventHandler(
             history, self.app_id,
             conf_snapshot=dict(conf.items()),

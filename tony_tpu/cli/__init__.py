@@ -80,6 +80,45 @@ def cmd_azkaban(args: argparse.Namespace) -> int:
     return azkaban_main(args)
 
 
+def cmd_profile(args: argparse.Namespace) -> int:
+    """Capture a trace from every rank of a RUNNING job into its history
+    dir (reference gap closed per SURVEY.md §5.1: hook + collection)."""
+    from pathlib import Path
+
+    from tony_tpu import constants
+    from tony_tpu.profiler import collect_traces, endpoints_from_callback_info
+    from tony_tpu.rpc import RpcClient
+    from tony_tpu.util import default_workdir
+
+    workdir = Path(args.workdir) if args.workdir else default_workdir()
+    job_dir = workdir / args.app_id
+    addr_file = job_dir / "am.address"
+    if not addr_file.is_file():
+        print(f"no live AM address for {args.app_id} under {workdir} "
+              f"(is the job running?)")
+        return 1
+    token_file = job_dir / "am.token"
+    token = token_file.read_text().strip() if token_file.is_file() else None
+    with RpcClient(addr_file.read_text().strip(), token=token,
+                   timeout=10.0) as c:
+        info = c.call("get_task_callback_info")
+    endpoints = endpoints_from_callback_info(info)
+    if not endpoints:
+        print("no profiler endpoints registered — set "
+              "tony.task.profiler.enabled=true on the job")
+        return 1
+    # The AM's history root (may be overridden by tony.history.location).
+    conf_path = job_dir / constants.TONY_JOB_JSON
+    history = job_dir / "history"
+    if conf_path.is_file():
+        loc = TonyConfig.load(conf_path).get(conf_mod.HISTORY_LOCATION)
+        if loc:
+            history = Path(loc)
+    collected = collect_traces(endpoints, history, args.app_id,
+                               duration_ms=args.duration_ms)
+    return 0 if collected else 1
+
+
 def cmd_version(_args: argparse.Namespace) -> int:
     print(f"tony-tpu {__version__}")
     return 0
@@ -140,6 +179,14 @@ def make_parser() -> argparse.ArgumentParser:
     a.add_argument("--workdir", help="client work dir")
     a.add_argument("--timeout", type=float, default=None)
     a.set_defaults(fn=cmd_azkaban)
+
+    pr = sub.add_parser("profile", help="capture a trace from every rank "
+                        "of a running job into its history dir")
+    pr.add_argument("app_id", help="application id of a RUNNING job")
+    pr.add_argument("--workdir", help="client work dir (default ~/.tony-tpu/jobs)")
+    pr.add_argument("--duration_ms", type=int, default=2000,
+                    help="trace capture window per rank")
+    pr.set_defaults(fn=cmd_profile)
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=cmd_version)

@@ -1,0 +1,174 @@
+"""Trace collection: fetch device/host traces from live profiler endpoints
+into the job's history dir (SURVEY.md §5.1 — the TPU-build commitment is
+"hook + trace collection to the history dir"; the hook half lives in
+:mod:`tony_tpu.distributed`, this is the collection half).
+
+The reference's equivalent surface is per-framework (TensorBoard reading a
+profile plugin dir); here every rank's user process runs
+``jax.profiler.start_server`` on the port the JAXRuntime assigned, the
+executor pushes ``host:port`` to the AM via ``register_callback_info``, and
+this module pulls a trace from each endpoint over the XLA profiler gRPC
+service into ``<history>/traces/<app_id>/<task_id>/`` — next to the jhist,
+where the history portal lists it.
+
+Two triggers, both optional:
+
+* ``tony profile <app_id>`` (client-side, any time while the job runs);
+* ``tony.task.profiler.collect-after-s`` (AM-side: one automatic capture
+  N seconds after the gang reaches RUNNING).
+
+The capture client is xprof's (version-matched to jax's tsl profiler
+service in this image); explicit tracer levels are passed because the
+defaults collect nothing from a remote jax server.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+# Tracer levels: host TraceMe spans + python + device. Without these the
+# remote session returns "no trace data" (measured, not hypothetical).
+_TRACE_OPTIONS = {
+    "host_tracer_level": 2,
+    "python_tracer_level": 1,
+    "device_tracer_level": 1,
+}
+
+
+def _trace_fn():
+    """Resolve a capture callable ``(addr, logdir, duration_ms) -> None``.
+    Import is deferred and gated: the profiler client is an optional
+    dependency and must not tax AM/executor startup."""
+    try:
+        from xprof.convert import _pywrap_profiler_plugin as pp
+
+        def capture(addr: str, logdir: str, duration_ms: int) -> None:
+            pp.trace(addr, logdir, "", True, duration_ms, 3, _TRACE_OPTIONS)
+
+        return capture
+    except ImportError:
+        pass
+    try:
+        from tensorflow.python.profiler import profiler_client
+
+        def capture(addr: str, logdir: str, duration_ms: int) -> None:
+            profiler_client.trace(f"grpc://{addr}", logdir, duration_ms,
+                                  options=_TRACE_OPTIONS)
+
+        return capture
+    except ImportError:
+        return None
+
+
+def traces_root(history_dir: str | Path, app_id: str) -> Path:
+    return Path(history_dir) / "traces" / app_id
+
+
+def endpoints_from_callback_info(info: Dict[str, str]) -> Dict[str, str]:
+    """``{task_id: host:port}`` of live profiler servers, from the per-task
+    callback payloads the executors pushed (``register_callback_info``)."""
+    import json
+
+    out: Dict[str, str] = {}
+    for task_id, payload in dict(info).items():
+        try:
+            parsed = json.loads(payload)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "profiler" in parsed:
+            out[task_id] = str(parsed["profiler"])
+    return out
+
+
+def _wait_reachable(addr: str, timeout_s: float) -> bool:
+    """Poll until ``host:port`` accepts TCP. The executor pushes the
+    endpoint at user-process LAUNCH — the profiler server inside it only
+    starts listening after the jax import, seconds later."""
+    import socket
+    import time
+
+    host, _, port = addr.rpartition(":")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection((host, int(port)), timeout=2.0).close()
+            return True
+        except OSError:
+            time.sleep(0.25)
+    return False
+
+
+def collect_traces(endpoints: Dict[str, str], history_dir: str | Path,
+                   app_id: str, duration_ms: int = 2000,
+                   wait_reachable_s: float = 60.0, log=print) -> List[Path]:
+    """Capture ONE synchronized trace session across every reachable
+    endpoint into ``<history>/traces/<app_id>/`` (one capture call over
+    the comma-joined address list — per-rank windows align in time, which
+    is the whole point of profiling cross-host collectives; a sequential
+    per-rank loop would give disjoint windows). A ``manifest.json``
+    records task_id → endpoint so the portal can attribute the per-host
+    xplane files. Unreachable ranks are reported and dropped from the
+    session — a partial profile beats none."""
+    import json
+
+    capture = _trace_fn()
+    if capture is None:
+        log("trace collection unavailable: no profiler client "
+            "(xprof / tensorflow) importable", file=sys.stderr)
+        return []
+    live = {}
+    for task_id, addr in sorted(endpoints.items()):
+        if _wait_reachable(addr, wait_reachable_s):
+            live[task_id] = addr
+        else:
+            log(f"trace capture from {task_id} ({addr}) skipped: "
+                f"endpoint not reachable within {wait_reachable_s:.0f}s")
+    if not live:
+        return []
+    dest = traces_root(history_dir, app_id)
+    dest.mkdir(parents=True, exist_ok=True)
+    (dest / "manifest.json").write_text(json.dumps(live, sort_keys=True))
+    try:
+        capture(",".join(live.values()), str(dest), duration_ms)
+    except Exception as e:  # noqa: BLE001 — profiling is advisory
+        log(f"trace capture from {sorted(live)} failed: {e}")
+        return []
+    if any(p.suffix == ".pb" for p in dest.rglob("*")):
+        log(f"synchronized trace from {sorted(live)} -> {dest}")
+        return [dest]
+    log(f"trace capture from {sorted(live)} produced no files")
+    return []
+
+
+def list_traces(history_dir: str | Path,
+                app_id: str) -> Dict[str, List[Dict[str, object]]]:
+    """Collected trace files per task, for the portal/CLI:
+    ``{task_id: [{file, bytes}, ...]}``. Files are attributed to tasks by
+    matching the manifest's endpoint (``host_port`` appears in the xplane
+    filename); unattributed files land under ``"session"``."""
+    import json
+
+    root = traces_root(history_dir, app_id)
+    if not root.is_dir():
+        return {}
+    manifest: Dict[str, str] = {}
+    mpath = root / "manifest.json"
+    if mpath.is_file():
+        try:
+            manifest = json.loads(mpath.read_text())
+        except ValueError:
+            pass
+    by_task: Dict[str, List[Dict[str, object]]] = {}
+    for p in sorted(root.rglob("*")):
+        if not p.is_file() or p.name == "manifest.json":
+            continue
+        entry = {"file": str(p.relative_to(root)), "bytes": p.stat().st_size}
+        owner = "session"
+        for task_id, addr in manifest.items():
+            if addr.replace(":", "_") in p.name:
+                owner = task_id.replace(":", "_")
+                break
+        by_task.setdefault(owner, []).append(entry)
+    return by_task
