@@ -1,0 +1,61 @@
+"""Real tf.distribute training across containers (graduation configs ①/②,
+SURVEY.md §6; reference: TestTonyE2E#testPSWorkerTrainingShouldPass runs an
+actually-training TF job, not an env check). MultiWorkerMirroredStrategy
+forms its collective ring purely from the TF_CONFIG the TFRuntime injected;
+a custom strategy.run loop (keras-3 fit no longer supports MWMS) trains a
+linear model and loss must decrease — real cross-container allreduce."""
+
+import json
+import os
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import numpy as np
+import tensorflow as tf
+
+tfc = json.loads(os.environ["TF_CONFIG"])
+assert tfc["task"]["type"] == "worker"
+rank = tfc["task"]["index"]
+n_workers = len(tfc["cluster"]["worker"])
+assert n_workers >= 2, tfc
+
+strategy = tf.distribute.MultiWorkerMirroredStrategy()
+assert strategy.num_replicas_in_sync == n_workers
+
+# Tiny synthetic linear regression; per-worker shards of a seeded dataset,
+# so the allreduced gradient spans data this worker never saw.
+rng = np.random.default_rng(0)
+xs = rng.normal(size=(128, 8)).astype(np.float32)
+w_true = rng.normal(size=(8, 1)).astype(np.float32)
+ys = xs @ w_true
+shard_x = xs[rank::n_workers]
+shard_y = ys[rank::n_workers]
+
+with strategy.scope():
+    w = tf.Variable(tf.zeros((8, 1)), name="w")
+    opt = tf.keras.optimizers.SGD(0.1)
+
+
+@tf.function
+def step(bx, by):
+    def replica_step(x, y):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(x @ w - y))
+        grads = tape.gradient(loss, [w])
+        opt.apply_gradients(zip(grads, [w]))  # allreduced under MWMS
+        return loss
+
+    per_replica = strategy.run(replica_step, args=(bx, by))
+    return strategy.reduce(tf.distribute.ReduceOp.MEAN, per_replica, axis=None)
+
+
+losses = []
+for _ in range(30):
+    losses.append(float(step(tf.constant(shard_x), tf.constant(shard_y))))
+assert losses[-1] < losses[0] * 0.5, losses  # really trained, not noise
+
+with open(f"tf_rank{rank}.json", "w") as f:
+    json.dump({"rank": rank, "n_workers": n_workers,
+               "loss_first": losses[0], "loss_last": losses[-1]}, f)
+print(f"tf worker {rank}/{n_workers}: loss {losses[0]:.4f} -> {losses[-1]:.4f}")

@@ -1,0 +1,54 @@
+"""Real ParameterServerStrategy training (graduation config ①, SURVEY.md §6;
+reference: TestTonyE2E#testPSWorkerTrainingShouldPass). Role-switched on the
+TF_CONFIG the TFRuntime injected: ps/worker run a tf.distribute.Server (they
+hold variables / run replica fns until the AM tears them down on chief
+success — the chief-done policy); the chief drives a ClusterCoordinator
+training loop whose loss must decrease."""
+
+import json
+import os
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import tensorflow as tf
+
+tfc = json.loads(os.environ["TF_CONFIG"])
+role, idx = tfc["task"]["type"], tfc["task"]["index"]
+
+if role in ("ps", "worker"):
+    server = tf.distribute.Server(tf.train.ClusterSpec(tfc["cluster"]),
+                                  job_name=role, task_index=idx,
+                                  protocol="grpc")
+    server.join()  # forever; the AM kills us when the chief finishes
+else:
+    import numpy as np
+
+    resolver = tf.distribute.cluster_resolver.TFConfigClusterResolver()
+    strategy = tf.distribute.ParameterServerStrategy(resolver)
+    coord = tf.distribute.coordinator.ClusterCoordinator(strategy)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 4)).astype("float32")
+    ys = xs @ rng.normal(size=(4, 1)).astype("float32")
+    with strategy.scope():  # variables land on the ps
+        w = tf.Variable(tf.zeros((4, 1)))
+        opt = tf.keras.optimizers.SGD(0.1)
+
+    @tf.function
+    def step():
+        def replica_fn():
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean(
+                    tf.square(tf.constant(xs) @ w - tf.constant(ys)))
+            grads = tape.gradient(loss, [w])
+            opt.apply_gradients(zip(grads, [w]))
+            return loss
+
+        return strategy.run(replica_fn)
+
+    losses = [float(coord.fetch(coord.schedule(step))) for _ in range(20)]
+    coord.join()
+    assert losses[-1] < losses[0] * 0.5, losses
+    with open("tf_ps_result.json", "w") as f:
+        json.dump({"loss_first": losses[0], "loss_last": losses[-1]}, f)
+    print(f"tf ps-strategy chief: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
