@@ -14,6 +14,22 @@ assert os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
 assert int(os.environ["HOROVOD_LOCAL_SIZE"]) >= 1
 assert int(os.environ["HOROVOD_CROSS_SIZE"]) >= 1
 
+# The driver-served slot table is the source of truth; the env ranks the
+# runtime computed independently must agree with it — one slot math, two
+# transports (this is what hvd.init() would consume from the rendezvous).
+from tony_tpu.runtime.horovod_driver import fetch_slots
+
+rdv = (os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] + ":"
+       + os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"])
+table = fetch_slots(rdv)
+assert table["ready"], table
+my_slot = table["slots"][rank]
+assert my_slot["rank"] == rank and my_slot["size"] == size, (my_slot, rank)
+assert my_slot["local_rank"] == int(os.environ["HOROVOD_LOCAL_RANK"])
+assert my_slot["local_size"] == int(os.environ["HOROVOD_LOCAL_SIZE"])
+assert my_slot["cross_rank"] == int(os.environ["HOROVOD_CROSS_RANK"])
+assert my_slot["cross_size"] == int(os.environ["HOROVOD_CROSS_SIZE"])
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
