@@ -86,7 +86,12 @@ def main() -> int:
     # the relay; longer windows shrink its share of the measurement.
     steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "4"))
 
-    model = get_model("resnet50")
+    # Fused pallas BN(+add)(+ReLU) epilogues (VERDICT r3 #1). Tried and
+    # measured SLOWER than XLA's fusions — see ROOFLINE.md: XLA already
+    # runs the BN reductions at/below the standalone-kernel HBM-pass
+    # lower bound, so the fused path stays flag-gated off.
+    fused_bn = os.environ.get("BENCH_FUSED_BN", "0") == "1"
+    model = get_model("resnet50", fused_bn=fused_bn)
     kx, ky, kinit = jax.random.split(jax.random.PRNGKey(0), 3)
     x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
     y = jax.random.randint(ky, (batch,), 0, 1000)
@@ -139,6 +144,7 @@ def main() -> int:
         "image": image,
         "backend": backend,
         "chip": gen,
+        "fused_bn": fused_bn,
         "loss": float(loss),
     }
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":

@@ -14,14 +14,74 @@ explicit allreduce.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from tony_tpu.models import register
 
 ModuleDef = Any
+
+
+class FusedBNAct(nn.Module):
+    """BatchNorm(+residual-add)(+ReLU) on the fused pallas kernels
+    (:mod:`tony_tpu.ops.batchnorm` — VERDICT r3 #1: the BN reductions are
+    51.3% of the ResNet step; this folds the whole epilogue into minimal
+    HBM passes). Param/stat names match ``nn.BatchNorm`` (scale/bias,
+    batch_stats mean/var). Falls back to plain XLA math when the shape
+    has no clean tiling, and for eval (running stats: one elementwise
+    pass XLA already fuses well)."""
+    relu: bool = True
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    scale_init: Any = nn.initializers.ones
+    interpret: bool = False     # CPU tests run the kernels interpreted
+
+    @nn.compact
+    def __call__(self, x, residual: Optional[jax.Array] = None):
+        from tony_tpu.ops.batchnorm import fused_bn_act
+
+        c = x.shape[-1]
+        gamma = self.param("scale", self.scale_init, (c,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda *_: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda *_: jnp.ones((c,), jnp.float32))
+        fused = None
+        if not self.use_running_average:
+            fused = fused_bn_act(x, gamma, beta, residual,
+                                 eps=self.epsilon, relu=self.relu,
+                                 interpret=self.interpret)
+        if fused is not None:
+            out, mean, var = fused
+        else:
+            if self.use_running_average:
+                mean, var = ra_mean.value, ra_var.value
+            else:  # XLA fallback for un-tileable shapes
+                xf = x.astype(jnp.float32)
+                axes = tuple(range(x.ndim - 1))
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.maximum(
+                    jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+            inv = jax.lax.rsqrt(var + self.epsilon) * gamma
+            out = (x.astype(jnp.float32) - mean) * inv + beta
+            if residual is not None:
+                out = out + residual.astype(jnp.float32)
+            if self.relu:
+                out = jnp.maximum(out, 0.0)
+            out = out.astype(x.dtype)
+        if not self.use_running_average and not self.is_initializing() \
+                and self.is_mutable_collection("batch_stats"):
+            mom = self.momentum
+            ra_mean.value = (mom * ra_mean.value
+                             + (1 - mom) * jax.lax.stop_gradient(mean))
+            ra_var.value = (mom * ra_var.value
+                            + (1 - mom) * jax.lax.stop_gradient(var))
+        return out
 
 
 class Bottleneck(nn.Module):
@@ -48,11 +108,39 @@ class Bottleneck(nn.Module):
         return nn.relu(y + residual)
 
 
+class FusedBottleneck(nn.Module):
+    """Bottleneck over the fused BN kernels: BN+ReLU epilogues are single
+    kernels, and the block exit (zeros-init BN + residual add + ReLU) is
+    ONE fused pass instead of three XLA fusions."""
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef    # partial(FusedBNAct, ...)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        if residual.shape[-1] != self.filters * 4 \
+                or self.strides != (1, 1):
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 self.strides, name="proj")(residual)
+            residual = self.norm(relu=False, name="proj_bn")(residual)
+        return self.norm(scale_init=nn.initializers.zeros)(
+            y, residual=residual)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16      # compute dtype; params stay f32
+    fused_bn: bool = False         # pallas BN+add+ReLU epilogues
+    bn_interpret: bool = False     # interpret pallas kernels (CPU tests)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -62,19 +150,29 @@ class ResNet(nn.Module):
         # (f32 norms would bounce every activation bf16->f32->bf16, doubling
         # HBM traffic on a bandwidth-bound model); running stats and
         # scale/bias params remain f32 via param_dtype.
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=jnp.float32)
+        if self.fused_bn:
+            norm = partial(FusedBNAct, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5,
+                           interpret=self.bn_interpret)
+            block_cls = FusedBottleneck
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           param_dtype=jnp.float32)
+            block_cls = Bottleneck
         x = x.astype(self.dtype)
         x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                  name="stem")(x)
-        x = nn.relu(norm(name="stem_bn")(x))
+        if self.fused_bn:
+            x = norm(name="stem_bn")(x)
+        else:
+            x = nn.relu(norm(name="stem_bn")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, size in enumerate(self.stage_sizes):
             for block in range(size):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
-                x = Bottleneck(self.width * 2 ** stage, strides,
-                               conv=conv, norm=norm)(x)
+                x = block_cls(self.width * 2 ** stage, strides,
+                              conv=conv, norm=norm)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      param_dtype=jnp.float32)(x)

@@ -1,0 +1,331 @@
+"""Fused BatchNorm(+residual-add)(+ReLU) pallas kernels.
+
+Why this exists (VERDICT r3 #1): the ResNet-50 bench's device trace blames
+51.3% of step time on BatchNorm statistics + backward reductions — 105
+`convert_reduce` XLA fusions that re-read every conv output (bf16→f32) for
+mean/var forward and dβ/dγ/dx backward, plus separate relu-backward and
+x̂ materializations. These kernels collapse the whole BN+add+ReLU epilogue
+into the minimum number of HBM passes:
+
+* forward: ONE stats pass (per-channel Σy and Σy² in a single read) and
+  ONE normalize+add+relu pass (read y [+residual], write out);
+* backward: ONE reduce pass producing dβ=Σg and dγ=Σg·x̂ — which are
+  exactly the two correction terms the dx formula needs — and ONE dx pass
+  (dx = γ·inv_σ·(g − dβ/M − x̂·dγ/M), plus dresidual=g for the add
+  variant). The ReLU mask is recomputed from y (and γ,β,μ,σ) in-kernel,
+  so no mask tensor and no saved x̂ ever touch HBM.
+
+Everything is VPU work over a [M, C] view (M = N·H·W rows, channels in
+lanes); accumulators ride the sequential TPU grid in f32. Shapes that
+don't tile cleanly return None from :func:`pick_block_rows` and callers
+fall back to the plain flax path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Total VMEM budget across every row-blocked buffer of the op's WORST
+# kernel (the dx pass), counting pallas's double buffering — the 16 MB
+# VMEM must also hold the channel-vector operands and headroom.
+_VMEM_BUDGET = 8 << 20
+
+
+def pick_block_rows(m: int, c: int, itemsize: int = 2,
+                    n_bufs: int = 3, n_temps: int = 8) -> Optional[int]:
+    """Largest power-of-two row block that divides M and keeps the worst
+    kernel within the VMEM budget: ``n_bufs`` double-buffered [bm, C]
+    io blocks PLUS ``n_temps`` single-buffered f32 [bm, C] stack
+    temporaries (xf/x̂/pre/g/dx… — Mosaic allocates kernel intermediates
+    on the VMEM stack, and at bf16 io the f32 temps dominate).
+    None = no clean tiling (caller falls back to XLA BatchNorm)."""
+    per_row = 2 * n_bufs * c * itemsize + n_temps * c * 4
+    limit = max(16, _VMEM_BUDGET // per_row)
+    for bm in (8192, 4096, 2048, 1024, 512, 256, 128, 64, 32, 16):
+        if bm <= limit and m % bm == 0:
+            return bm
+    return None
+
+
+def _stats_kernel(x_ref, out_ref):
+    i = pl.program_id(0)
+    xf = x_ref[...].astype(jnp.float32)
+    part = jnp.concatenate([
+        jnp.sum(xf, axis=0, keepdims=True),
+        jnp.sum(xf * xf, axis=0, keepdims=True)], axis=0)   # [2, C]
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        out_ref[...] += part
+
+
+def _bn_sums(x2d: jax.Array, bm: int, interpret: bool) -> jax.Array:
+    m, c = x2d.shape
+    return pl.pallas_call(
+        _stats_kernel,
+        grid=(m // bm,),
+        in_specs=[pl.BlockSpec((bm, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((2, c), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, c), jnp.float32),
+        interpret=interpret,
+    )(x2d)
+
+
+def _pre_act(x_ref, stats_ref, gb_ref, eps):
+    """Normalized pre-activation x̂·γ+β (f32) and x̂, from the raw input —
+    the shared recompute used by apply and both backward kernels."""
+    mean = stats_ref[0:1, :]
+    inv = jax.lax.rsqrt(stats_ref[1:2, :] + eps)
+    xhat = (x_ref[...].astype(jnp.float32) - mean) * inv
+    pre = xhat * gb_ref[0:1, :] + gb_ref[1:2, :]
+    return pre, xhat, inv
+
+
+def _apply_kernel(x_ref, stats_ref, gb_ref, out_ref, *, eps, relu):
+    pre, _, _ = _pre_act(x_ref, stats_ref, gb_ref, eps)
+    if relu:
+        pre = jnp.maximum(pre, 0.0)
+    out_ref[...] = pre.astype(out_ref.dtype)
+
+
+def _apply_res_kernel(x_ref, res_ref, stats_ref, gb_ref, out_ref, *,
+                      eps, relu):
+    pre, _, _ = _pre_act(x_ref, stats_ref, gb_ref, eps)
+    pre = pre + res_ref[...].astype(jnp.float32)
+    if relu:
+        pre = jnp.maximum(pre, 0.0)
+    out_ref[...] = pre.astype(out_ref.dtype)
+
+
+def _bwd_reduce_kernel(dy_ref, x_ref, stats_ref, gb_ref, out_ref, *,
+                       eps, relu):
+    i = pl.program_id(0)
+    pre, xhat, _ = _pre_act(x_ref, stats_ref, gb_ref, eps)
+    g = dy_ref[...].astype(jnp.float32)
+    if relu:
+        g = jnp.where(pre > 0, g, 0.0)
+    part = jnp.concatenate([
+        jnp.sum(g, axis=0, keepdims=True),             # dβ
+        jnp.sum(g * xhat, axis=0, keepdims=True)], axis=0)   # dγ
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        out_ref[...] += part
+
+
+def _bwd_reduce_res_kernel(dy_ref, x_ref, res_ref, stats_ref, gb_ref,
+                           out_ref, *, eps, relu):
+    i = pl.program_id(0)
+    pre, xhat, _ = _pre_act(x_ref, stats_ref, gb_ref, eps)
+    g = dy_ref[...].astype(jnp.float32)
+    if relu:
+        pre = pre + res_ref[...].astype(jnp.float32)
+        g = jnp.where(pre > 0, g, 0.0)
+    part = jnp.concatenate([
+        jnp.sum(g, axis=0, keepdims=True),
+        jnp.sum(g * xhat, axis=0, keepdims=True)], axis=0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        out_ref[...] += part
+
+
+def _bwd_dx_kernel(dy_ref, x_ref, stats_ref, gb_ref, red_ref, dx_ref, *,
+                   eps, relu, minv):
+    pre, xhat, inv = _pre_act(x_ref, stats_ref, gb_ref, eps)
+    g = dy_ref[...].astype(jnp.float32)
+    if relu:
+        g = jnp.where(pre > 0, g, 0.0)
+    scale = gb_ref[0:1, :] * inv
+    dx = scale * (g - red_ref[0:1, :] * minv - xhat * red_ref[1:2, :] * minv)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _bwd_dx_res_kernel(dy_ref, x_ref, res_ref, stats_ref, gb_ref, red_ref,
+                       dx_ref, dres_ref, *, eps, relu, minv):
+    pre, xhat, inv = _pre_act(x_ref, stats_ref, gb_ref, eps)
+    g = dy_ref[...].astype(jnp.float32)
+    if relu:
+        pre = pre + res_ref[...].astype(jnp.float32)
+        g = jnp.where(pre > 0, g, 0.0)
+    dres_ref[...] = g.astype(dres_ref.dtype)
+    scale = gb_ref[0:1, :] * inv
+    dx = scale * (g - red_ref[0:1, :] * minv - xhat * red_ref[1:2, :] * minv)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _row_spec(bm, c):
+    return pl.BlockSpec((bm, c), lambda i: (i, 0))
+
+
+def _chan_spec(c):
+    return pl.BlockSpec((2, c), lambda i: (0, 0))
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrappers ([M, C] view; the flax module reshapes NHWC)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def bn_act_2d(x2d, gamma, beta, eps: float, relu: bool, bm: int,
+              interpret: bool = False):
+    """Fused train-mode BatchNorm(+ReLU) over [M, C]: returns
+    ``(out, mean, var)`` — mean/var are batch statistics for the running
+    averages (their cotangents are ignored; consumers stop-gradient them,
+    and the batch-statistic chain rule is already inside the dx formula)."""
+    out, mean, var, _, _ = _bn_act_fwd_impl(
+        x2d, gamma, beta, None, eps, relu, bm, interpret)
+    return out, mean, var
+
+
+def _bn_act_fwd_impl(x2d, gamma, beta, res2d, eps, relu, bm, interpret):
+    m, c = x2d.shape
+    sums = _bn_sums(x2d, bm, interpret)
+    mean = sums[0] / m
+    var = jnp.maximum(sums[1] / m - mean * mean, 0.0)
+    stats = jnp.stack([mean, var])              # [2, C] f32
+    gb = jnp.stack([gamma, beta]).astype(jnp.float32)
+    if res2d is None:
+        out = pl.pallas_call(
+            functools.partial(_apply_kernel, eps=eps, relu=relu),
+            grid=(m // bm,),
+            in_specs=[_row_spec(bm, c), _chan_spec(c), _chan_spec(c)],
+            out_specs=_row_spec(bm, c),
+            out_shape=jax.ShapeDtypeStruct((m, c), x2d.dtype),
+            interpret=interpret,
+        )(x2d, stats, gb)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_apply_res_kernel, eps=eps, relu=relu),
+            grid=(m // bm,),
+            in_specs=[_row_spec(bm, c), _row_spec(bm, c), _chan_spec(c),
+                      _chan_spec(c)],
+            out_specs=_row_spec(bm, c),
+            out_shape=jax.ShapeDtypeStruct((m, c), x2d.dtype),
+            interpret=interpret,
+        )(x2d, res2d, stats, gb)
+    return out, mean, var, stats, gb
+
+
+def _bn_act_fwd(x2d, gamma, beta, eps, relu, bm, interpret):
+    out, mean, var, stats, gb = _bn_act_fwd_impl(
+        x2d, gamma, beta, None, eps, relu, bm, interpret)
+    return (out, mean, var), (x2d, stats, gb)
+
+
+def _bn_act_bwd(eps, relu, bm, interpret, saved, cts):
+    dy, _, _ = cts          # mean/var feed only stop-gradient'd consumers
+    x2d, stats, gb = saved
+    m, c = x2d.shape
+    red = pl.pallas_call(
+        functools.partial(_bwd_reduce_kernel, eps=eps, relu=relu),
+        grid=(m // bm,),
+        in_specs=[_row_spec(bm, c), _row_spec(bm, c), _chan_spec(c),
+                  _chan_spec(c)],
+        out_specs=_chan_spec(c),
+        out_shape=jax.ShapeDtypeStruct((2, c), jnp.float32),
+        interpret=interpret,
+    )(dy, x2d, stats, gb)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, eps=eps, relu=relu, minv=1.0 / m),
+        grid=(m // bm,),
+        in_specs=[_row_spec(bm, c), _row_spec(bm, c), _chan_spec(c),
+                  _chan_spec(c), _chan_spec(c)],
+        out_specs=_row_spec(bm, c),
+        out_shape=jax.ShapeDtypeStruct((m, c), x2d.dtype),
+        interpret=interpret,
+    )(dy, x2d, stats, gb, red)
+    # red = [Σg, Σg·x̂] = [dβ, dγ]; cotangent order follows (x, gamma, beta).
+    return dx, red[1], red[0]
+
+
+bn_act_2d.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def bn_add_act_2d(x2d, gamma, beta, res2d, eps: float, relu: bool,
+                  bm: int, interpret: bool = False):
+    """Fused BatchNorm + residual add (+ReLU): ``relu(bn(x) + res)`` —
+    the bottleneck-exit epilogue in one pass. Returns (out, mean, var)."""
+    out, mean, var, _, _ = _bn_act_fwd_impl(
+        x2d, gamma, beta, res2d, eps, relu, bm, interpret)
+    return out, mean, var
+
+
+def _bn_add_act_fwd(x2d, gamma, beta, res2d, eps, relu, bm, interpret):
+    out, mean, var, stats, gb = _bn_act_fwd_impl(
+        x2d, gamma, beta, res2d, eps, relu, bm, interpret)
+    return (out, mean, var), (x2d, res2d, stats, gb)
+
+
+def _bn_add_act_bwd(eps, relu, bm, interpret, saved, cts):
+    dy, _, _ = cts
+    x2d, res2d, stats, gb = saved
+    m, c = x2d.shape
+    red = pl.pallas_call(
+        functools.partial(_bwd_reduce_res_kernel, eps=eps, relu=relu),
+        grid=(m // bm,),
+        in_specs=[_row_spec(bm, c), _row_spec(bm, c), _row_spec(bm, c),
+                  _chan_spec(c), _chan_spec(c)],
+        out_specs=_chan_spec(c),
+        out_shape=jax.ShapeDtypeStruct((2, c), jnp.float32),
+        interpret=interpret,
+    )(dy, x2d, res2d, stats, gb)
+    dx, dres = pl.pallas_call(
+        functools.partial(_bwd_dx_res_kernel, eps=eps, relu=relu,
+                          minv=1.0 / m),
+        grid=(m // bm,),
+        in_specs=[_row_spec(bm, c), _row_spec(bm, c), _row_spec(bm, c),
+                  _chan_spec(c), _chan_spec(c), _chan_spec(c)],
+        out_specs=(_row_spec(bm, c), _row_spec(bm, c)),
+        out_shape=(jax.ShapeDtypeStruct((m, c), x2d.dtype),
+                   jax.ShapeDtypeStruct((m, c), res2d.dtype)),
+        interpret=interpret,
+    )(dy, x2d, res2d, stats, gb, red)
+    return dx, red[1], red[0], dres
+
+
+bn_add_act_2d.defvjp(_bn_add_act_fwd, _bn_add_act_bwd)
+
+
+def fused_bn_act(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                 residual: Optional[jax.Array] = None, *,
+                 eps: float = 1e-5, relu: bool = True,
+                 interpret: bool = False,
+                 ) -> Optional[Tuple[jax.Array, jax.Array, jax.Array]]:
+    """NHWC (or any [..., C]) entry: train-mode fused BN(+add)(+ReLU).
+    Returns ``(out, mean, var)`` or None when the shape has no clean
+    tiling (caller must fall back to the XLA path)."""
+    c = x.shape[-1]
+    m = x.size // c
+    # Worst kernel: the dx pass — (dy, x[, res]) in, (dx[, dres]) out.
+    n_bufs = 3 if residual is None else 5
+    bm = pick_block_rows(m, c, jnp.dtype(x.dtype).itemsize, n_bufs)
+    if bm is None:
+        return None
+    x2d = x.reshape(m, c)
+    if residual is None:
+        out, mean, var = bn_act_2d(x2d, gamma, beta, eps, relu, bm,
+                                   interpret)
+    else:
+        out, mean, var = bn_add_act_2d(x2d, gamma, beta,
+                                       residual.reshape(m, c), eps, relu,
+                                       bm, interpret)
+    return out.reshape(x.shape), mean, var
