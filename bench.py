@@ -180,18 +180,27 @@ def bench_llm(peak: float) -> dict:
     layers = int(os.environ.get("BENCH_LLM_LAYERS", "12"))
     remat = os.environ.get("BENCH_LLM_REMAT", "0") == "1"
     scan_layers = os.environ.get("BENCH_LLM_SCAN", "0") == "1"
+    # Row-chunked fused head+CE (train.chunked_next_token_xent): the
+    # [B,T,V] logits never materialize, lifting the f32-logits HBM cap
+    # that limited batch to 32. 0 = plain head + next_token_loss.
+    xent_chunk = int(os.environ.get("BENCH_LLM_XENT_CHUNK", "0"))
     model = get_model(
         "llama2-7b", dim=dim, n_layers=layers, n_heads=heads,
         n_kv_heads=heads, ffn_hidden=ffn, vocab=32768, max_seq=seq,
         attention=os.environ.get("BENCH_LLM_ATTN", "flash"),
-        scan_layers=scan_layers, remat=remat)
+        scan_layers=scan_layers, remat=remat, xent_chunk=xent_chunk)
     cfg = model.cfg
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab)
     state = tr.create_train_state(
         model, optax.adamw(1e-4), tokens, jax.random.PRNGKey(1))
-    step = tr.make_train_step(
-        loss_of=lambda logits, b: tr.next_token_loss(logits, b["x"]))
+    if xent_chunk:
+        step = tr.make_train_step(
+            loss_of=lambda out, b: out,
+            apply_kwargs_of=lambda b: {"targets": b["x"]})
+    else:
+        step = tr.make_train_step(
+            loss_of=lambda logits, b: tr.next_token_loss(logits, b["x"]))
 
     steps = int(os.environ.get("BENCH_LLM_STEPS", "20"))
     # One dispatch per timed window (see the resnet window comment).

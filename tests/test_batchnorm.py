@@ -85,6 +85,9 @@ def test_pick_block_rows_budget_and_divisibility():
         # Double-buffered blocks of the worst kernel fit the VMEM budget.
         assert 2 * n_bufs * bm * 2048 * 2 <= 8 << 20
     assert pick_block_rows(17, 64) is None  # prime-ish M: no clean tiling
+    # Very wide C: even 16 rows blow the budget — must fall back to XLA,
+    # not dispatch a kernel that OOMs VMEM at compile time.
+    assert pick_block_rows(1024, 32768) is None
 
 
 def _rename_fused(tree):

@@ -227,6 +227,35 @@ def test_stage_skips_nested_workdir(tmp_path):
     assert not (staged2 / client2.app_id).exists()  # job dir pruned
 
 
+def test_relative_workdir_venv_reaches_containers(tmp_path, monkeypatch):
+    """A RELATIVE --workdir must not produce relative staged paths: the
+    venv path resolved fine in the AM's cwd but localized nothing in the
+    containers (found live in round 4). Also pins hardlink localization."""
+    monkeypatch.chdir(tmp_path)
+    src = Path("proj")
+    src.mkdir()
+    for name in ("check_venv.py",):
+        (src / name).write_text((WORKLOADS / name).read_text())
+    venv = Path("myvenv")
+    (venv / "bin").mkdir(parents=True)
+    marker = venv / "bin" / "tony-venv-marker"
+    marker.write_text("#!/bin/sh")
+    marker.chmod(0o755)
+    client = TonyClient(
+        TonyConfig(base_props(**{
+            "tony.application.executes": "python check_venv.py",
+            "tony.application.python-venv": "myvenv",
+            "tony.worker.instances": "2"})),
+        src_dir=src, workdir=Path("jobs"), stream=io.StringIO())
+    assert client.run(timeout=90) == 0
+    localized = sorted(client.job_dir.glob(
+        "containers/*/venv/bin/tony-venv-marker"))
+    assert len(localized) == 2
+    staged_ino = (client.job_dir / "venv" / "bin"
+                  / "tony-venv-marker").stat().st_ino
+    assert all(p.stat().st_ino == staged_ino for p in localized)
+
+
 def test_history_read_path_is_cached(tmp_path, monkeypatch):
     """VERDICT r3 #7: a second request over an unchanged history dir must do
     zero re-parsing (mtime/size-keyed cache), and long TASK_METRICS

@@ -158,10 +158,10 @@ def test_preemption_relaunches_task(pod):
     # relaunch happening, not how fast.
     job.wait_for(lambda: victim.preemption_retries == 1
                  and victim.status is TaskStatus.RUNNING,
-                 timeout=120, what="preempted task relaunched")
+                 timeout=180, what="preempted task relaunched")
     assert job.session.job_status is JobStatus.RUNNING
     job.kill()
-    assert job.wait(timeout=60) == 1
+    assert job.wait(timeout=120) == 1
     assert job.session.job_status is JobStatus.KILLED
 
 

@@ -68,3 +68,19 @@ def test_rpc_client_worst_case_call_bound():
     assert RpcClient.worst_case_call_s(1.0) == 1.0 + 2.0 * 1.0
     # Long-timeout clients stay capped at the socket timeout per op.
     assert RpcClient.worst_case_call_s(60.0) == 60.0 + 2.0 * 10.0
+
+
+def test_link_tree_localizes_by_hardlink(tmp_path):
+    """Venv/src localization links instead of copying (metadata-only per
+    container — the submit→all-running latency lever); content identical,
+    falls back to copy only across filesystems."""
+    from tony_tpu.executor import _link_tree
+
+    src = tmp_path / "venv"
+    (src / "bin").mkdir(parents=True)
+    (src / "bin" / "python").write_text("#!/bin/sh\n")
+    (src / "lib.py").write_text("x = 1\n")
+    dest = tmp_path / "localized"
+    _link_tree(src, dest)
+    assert (dest / "bin" / "python").read_text() == "#!/bin/sh\n"
+    assert (dest / "lib.py").stat().st_ino == (src / "lib.py").stat().st_ino

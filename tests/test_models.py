@@ -144,3 +144,58 @@ def test_llama_packed_attention_branch_matches_reference():
     out_ref = ref_model.apply(variables, tokens)
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
                                atol=5e-2, rtol=5e-2)
+
+
+def test_chunked_xent_matches_plain_head():
+    """cfg.xent_chunk fuses head+loss without materializing logits; the
+    loss AND all shared-param grads must match the plain head + 
+    next_token_loss path (the lm_head kernel moves from lm_head/kernel to
+    lm_head_kernel — remapped here)."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 256)
+    plain = get_model("llama-tiny", dtype=jnp.float32)
+    fused = get_model("llama-tiny", dtype=jnp.float32, xent_chunk=8)
+    variables = plain.init(jax.random.PRNGKey(0), tokens)
+    fparams = dict(variables["params"])
+    fparams["lm_head_kernel"] = fparams.pop("lm_head")["kernel"]
+
+    def loss_plain(p):
+        logits = plain.apply({"params": p}, tokens)
+        return train.next_token_loss(logits, tokens)
+
+    def loss_fused(p):
+        return fused.apply({"params": p}, tokens, targets=tokens)
+
+    lp, gp = jax.value_and_grad(loss_plain)(variables["params"])
+    lf, gf = jax.value_and_grad(loss_fused)(fparams)
+    np.testing.assert_allclose(float(lf), float(lp), rtol=1e-5)
+    gp = dict(gp)
+    gp["lm_head_kernel"] = gp.pop("lm_head")["kernel"]
+    for (kp, a), (kf, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(gp),
+                   key=lambda t: str(t[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(gf),
+                   key=lambda t: str(t[0]))):
+        assert str(kp) == str(kf)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-5, rtol=1e-4, err_msg=str(kp))
+    # 2·16 = 32 rows over chunk=8 → 4 whole chunks; also exercise padding.
+    fused_pad = get_model("llama-tiny", dtype=jnp.float32, xent_chunk=7)
+    lpad = fused_pad.apply({"params": fparams}, tokens, targets=tokens)
+    np.testing.assert_allclose(float(lpad), float(lp), rtol=1e-5)
+
+
+def test_chunked_xent_through_train_step():
+    """The train harness drives the fused-loss model via apply_kwargs_of;
+    loss decreases like the plain path."""
+    model = get_model("llama-tiny", xent_chunk=8)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 256)
+    state = train.create_train_state(
+        model, optax.adam(1e-2), tokens, jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        loss_of=lambda out, batch: out,
+        apply_kwargs_of=lambda batch: {"targets": batch["x"]})
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, {"x": tokens})
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]

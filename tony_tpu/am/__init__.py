@@ -26,6 +26,7 @@ import secrets
 import sys
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Dict, Optional
 
@@ -135,7 +136,10 @@ class ApplicationMaster:
             env[constants.ENV_SRC_DIR] = str(src)
         venv = self.conf.get(conf_mod.PYTHON_VENV)
         if venv and Path(venv).exists():
-            env[constants.ENV_VENV] = str(venv)
+            # Resolve against the AM's cwd (= the client's, which wrote the
+            # conf): executors run elsewhere and a relative path would
+            # silently localize nothing.
+            env[constants.ENV_VENV] = str(Path(venv).resolve())
         if self.token:
             env[ENV_JOB_TOKEN] = self.token
         container = self.scheduler.launch(ContainerLaunch(
@@ -331,16 +335,28 @@ class ApplicationMaster:
         app_timeout_s = conf.get_int(conf_mod.APPLICATION_TIMEOUT, 0) / 1e3
         pending = [(jt, i) for jt in conf.job_types()
                    for i in range(conf.instances(jt))]
+        launch_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="launch")
         try:
             while True:
                 # Launch whatever the adapter allows (Horovod gates workers
-                # on its driver being up — ``canStartTask``).
+                # on its driver being up — ``canStartTask``). Launches run
+                # CONCURRENTLY: on the ssh substrate each launch pays
+                # staging + connection latency, and a serial loop makes the
+                # submit→all-running latency O(gang size) (SURVEY.md §7
+                # hard part #4). The pool is joined before the tick
+                # continues so completed-container/heartbeat checks never
+                # race a half-launched task.
                 still_pending = []
+                launching = []
                 for jt, i in pending:
                     if am_adapter.can_start_task(jt, i):
-                        self._try_launch(session, jt, i)
+                        launching.append(launch_pool.submit(
+                            self._try_launch, session, jt, i))
                     else:
                         still_pending.append((jt, i))
+                for f in launching:
+                    f.result()
                 pending = still_pending
 
                 self._handle_completed_containers(session)
@@ -377,6 +393,7 @@ class ApplicationMaster:
                     break
                 time.sleep(_TICK_S)
         finally:
+            launch_pool.shutdown(wait=True)
             # Teardown: untracked sidecars and any stragglers die with the job.
             session.kill_remaining(
                 f"job finished: {session.job_status.value}")

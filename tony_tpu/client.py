@@ -70,7 +70,12 @@ class TonyClient:
         self.am_host = am_host
         self.quiet = quiet
         self.stream = stream or sys.stderr
-        self.job_dir = self.workdir / self.app_id
+        # Resolved: paths derived from the job dir (staged venv/src) are
+        # shipped through the conf to executors running with a DIFFERENT
+        # cwd — a relative --workdir must not produce relative staged
+        # paths (found live: a relative venv path resolved fine in the
+        # AM's cwd, then silently vanished in every container).
+        self.job_dir = (self.workdir / self.app_id).resolve()
         self.am_proc: Optional[subprocess.Popen] = None
         self._am_launches = 0
         self.final_status: Optional[str] = None

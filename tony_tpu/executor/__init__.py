@@ -64,6 +64,24 @@ def _proc_descendants(root: int) -> list:
     return out
 
 
+def _link_tree(src: Path, dest: Path, symlinks: bool = False) -> None:
+    """copytree that hardlinks file content instead of copying (falls back
+    to a real copy across filesystems). Venvs run to GBs and localization
+    is per-container — a byte copy per container is the dominant cost in
+    the submit→all-running latency (SURVEY.md §7 hard part #4); links make
+    it metadata-only. ONLY for trees used read-only by convention (the
+    venv): an in-place write through a hardlink would mutate the staged
+    copy and every sibling container. src trees keep real copies — user
+    code freely writes into its own src dir."""
+    def _link(s, d, **kw):
+        try:
+            os.link(s, d)
+        except OSError:           # cross-device, perms, or FS without links
+            shutil.copy2(s, d)
+
+    shutil.copytree(src, dest, symlinks=symlinks, copy_function=_link)
+
+
 def reserve_port(host: str = "") -> socket.socket:
     """Bind a listening socket on an ephemeral port and keep it open —
     the reference's ServerSocket reservation. Caller closes just before the
@@ -198,7 +216,7 @@ class TaskExecutor:
         if dest.exists():
             return dest
         if src.is_dir():
-            shutil.copytree(src, dest, symlinks=True)
+            _link_tree(src, dest, symlinks=True)
         elif src.is_file():
             shutil.unpack_archive(str(src), str(dest))
             # Archives often wrap a single top-level dir: flatten to it.

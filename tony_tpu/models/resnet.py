@@ -38,6 +38,7 @@ class FusedBNAct(nn.Module):
     momentum: float = 0.9
     epsilon: float = 1e-5
     scale_init: Any = nn.initializers.ones
+    dtype: Any = jnp.bfloat16   # compute dtype for the non-kernel paths
     interpret: bool = False     # CPU tests run the kernels interpreted
 
     @nn.compact
@@ -67,10 +68,16 @@ class FusedBNAct(nn.Module):
                 mean = jnp.mean(xf, axis=axes)
                 var = jnp.maximum(
                     jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
-            inv = jax.lax.rsqrt(var + self.epsilon) * gamma
-            out = (x.astype(jnp.float32) - mean) * inv + beta
+            # Elementwise math in the compute dtype (like nn.BatchNorm
+            # with dtype=bf16): an f32 path would bounce every activation
+            # bf16→f32→bf16 — doubled HBM traffic on a bandwidth-bound
+            # model. Only the [C]-vector prep stays f32.
+            ct = self.dtype
+            inv = (jax.lax.rsqrt(var + self.epsilon) * gamma)
+            out = (x.astype(ct) - mean.astype(ct)) * inv.astype(ct) \
+                + beta.astype(ct)
             if residual is not None:
-                out = out + residual.astype(jnp.float32)
+                out = out + residual.astype(ct)
             if self.relu:
                 out = jnp.maximum(out, 0.0)
             out = out.astype(x.dtype)
@@ -152,7 +159,7 @@ class ResNet(nn.Module):
         # scale/bias params remain f32 via param_dtype.
         if self.fused_bn:
             norm = partial(FusedBNAct, use_running_average=not train,
-                           momentum=0.9, epsilon=1e-5,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                            interpret=self.bn_interpret)
             block_cls = FusedBottleneck
         else:

@@ -53,6 +53,11 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # >0 fuses the LM head with a row-chunked cross entropy: the [B,T,V]
+    # logits tensor (f32: 4 GB at b64·s512·v32k) never materializes —
+    # per-chunk logits are consumed immediately and rematerialized in the
+    # backward. __call__ then takes targets and returns the scalar loss.
+    xent_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -241,7 +246,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, targets=None):
         cfg = self.cfg
         _b, t = tokens.shape
         embed = self.param("embedding", nn.with_logical_partitioning(
@@ -267,6 +272,19 @@ class Transformer(nn.Module):
             for i in range(cfg.n_layers):
                 x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if cfg.xent_chunk:
+            # Fused head+loss: the kernel is hoisted to this scope (param
+            # path "lm_head_kernel" instead of "lm_head/kernel") and the
+            # row-chunked CE never materializes full logits. Without
+            # targets (init / inference) it degrades to a plain head.
+            from tony_tpu.train import chunked_next_token_xent
+            w = self.param("lm_head_kernel", nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")),
+                (cfg.dim, cfg.vocab), jnp.float32)
+            if targets is not None:
+                return chunked_next_token_xent(x, w, targets,
+                                               cfg.xent_chunk, cfg.dtype)
+            return (x @ w.astype(cfg.dtype)).astype(jnp.float32)
         # lm_head matmul in bf16 (an f32 matmul runs at a fraction of MXU
         # bf16 peak and this is ~2·dim·vocab FLOPs/token); logits cast to
         # f32 afterwards for a stable softmax in the loss.

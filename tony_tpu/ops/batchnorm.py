@@ -45,7 +45,10 @@ def pick_block_rows(m: int, c: int, itemsize: int = 2,
     on the VMEM stack, and at bf16 io the f32 temps dominate).
     None = no clean tiling (caller falls back to XLA BatchNorm)."""
     per_row = 2 * n_bufs * c * itemsize + n_temps * c * 4
-    limit = max(16, _VMEM_BUDGET // per_row)
+    # No floor: if even 16 rows exceed the budget (very wide C), every
+    # candidate must fail so the caller takes the XLA fallback instead of
+    # dispatching a kernel that OOMs VMEM at Mosaic compile time.
+    limit = _VMEM_BUDGET // per_row
     for bm in (8192, 4096, 2048, 1024, 512, 256, 128, 64, 32, 16):
         if bm <= limit and m % bm == 0:
             return bm

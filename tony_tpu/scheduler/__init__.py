@@ -310,7 +310,8 @@ class TpuVmScheduler(ContainerScheduler):
         self._host_tasks: Dict[str, int] = {h: 0 for h in self.hosts}
         self._running: Dict[str, Container] = {}
         self._lock = threading.Lock()
-        self._stage_lock = threading.Lock()
+        self._stage_lock = threading.Lock()      # guards the lock table
+        self._host_stage_locks: Dict[str, threading.Lock] = {}
         self._next_id = 0
         self._staged_hosts: set = set()
 
@@ -400,12 +401,20 @@ class TpuVmScheduler(ContainerScheduler):
                 f"staging {local} -> {host}:{self.remote_workdir}/{subdir} "
                 f"failed (rc={proc.returncode}): {proc.stderr[-500:]}")
 
+    def _host_stage_lock(self, host: str) -> "threading.Lock":
+        with self._stage_lock:
+            return self._host_stage_locks.setdefault(host, threading.Lock())
+
     def _stage_once(self, launch: ContainerLaunch, host: str) -> None:
         """Stage conf + src + venv onto the worker the first time it's
         used. The host is marked staged only after every transfer succeeds;
         a failure raises so the launch (and the job) fails loudly instead
-        of executors dying later on a missing-conf error."""
-        with self._stage_lock:
+        of executors dying later on a missing-conf error. Serialized PER
+        HOST (not globally): the AM launches a gang concurrently, and one
+        global lock would make first-time staging to N hosts O(N) in
+        transfer time — the exact latency the concurrent launches exist
+        to remove."""
+        with self._host_stage_lock(host):
             if host in self._staged_hosts:
                 return
             conf_path = launch.env.get(constants.ENV_CONF_PATH)

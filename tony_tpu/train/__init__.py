@@ -41,6 +41,47 @@ def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
 
 
+def chunked_next_token_xent(hidden: jax.Array, lm_head: jax.Array,
+                            tokens: jax.Array, chunk: int,
+                            dtype=jnp.bfloat16) -> jax.Array:
+    """Fused LM-head + causal cross entropy without materializing the
+    [B, T, V] logits (f32: 4 GB at b64·s512·v32k — the tensor that capped
+    the bench batch at 32). Rows are processed in ``chunk``-sized scan
+    steps: per-chunk bf16 logits on the MXU, f32 logsumexp − label logit,
+    summed into a carry; ``jax.checkpoint`` on the body recomputes the
+    chunk logits in the backward instead of stacking them as residuals
+    (which would rebuild the full tensor)."""
+    d = hidden.shape[-1]
+    rows = hidden[:, :-1].reshape(-1, d)
+    labels = tokens[:, 1:].reshape(-1)
+    r = rows.shape[0]
+    n = max(1, r // chunk)
+    if r % chunk:
+        # Pad to a whole number of chunks; padded rows get weight 0.
+        pad = n * chunk + chunk - r
+        n += 1
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad))
+        weights = jnp.pad(jnp.ones((r,), jnp.float32), (0, pad))
+    else:
+        weights = jnp.ones((r,), jnp.float32)
+    wb = lm_head.astype(dtype)
+
+    @jax.checkpoint
+    def body(acc, xs):
+        hc, lc, mc = xs
+        logits = (hc @ wb).astype(jnp.float32)          # [chunk, V]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        lab = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+        return acc + ((lse - lab) * mc).sum(), None
+
+    total, _ = jax.lax.scan(
+        body, jnp.float32(0.0),
+        (rows.reshape(n, chunk, d), labels.reshape(n, chunk),
+         weights.reshape(n, chunk)))
+    return total / r
+
+
 def param_shardings(model: nn.Module, sample_input: jax.Array, mesh: Mesh,
                     rng: Optional[jax.Array] = None,
                     rules=par.RULES) -> Tuple[Any, Any]:
@@ -81,12 +122,17 @@ def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
                                       jax.Array] = None,
                     mesh: Optional[Mesh] = None,
                     rules=par.RULES,
-                    donate: bool = True):
+                    donate: bool = True,
+                    apply_kwargs_of: Optional[Callable[
+                        [Dict[str, jax.Array]], Dict[str, Any]]] = None):
     """Build the jitted train step ``(state, batch) -> (state, metrics)``.
 
     ``loss_of(logits, batch)`` defaults to classification cross entropy on
     ``batch={'x', 'y'}``. With a mesh, the batch is constrained onto the DP
     axes so GSPMD shards compute and allreduces grads over ICI.
+    ``apply_kwargs_of(batch)`` feeds extra kwargs to the model (e.g.
+    ``targets`` for a model with a fused head+loss — ``loss_of`` then
+    receives the model's scalar loss as its first argument).
     """
     if loss_of is None:
         loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
@@ -98,12 +144,14 @@ def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
                     x, par.batch_sharding(mesh)), batch)
 
         def loss_fn(params):
+            extra = apply_kwargs_of(batch) if apply_kwargs_of else {}
             with nn.logical_axis_rules(rules):
                 # mutable="losses": models that sow auxiliary objectives
                 # (e.g. the MoE load-balancing loss) contribute them here;
                 # dense models return an empty collection.
                 logits, sown = state.apply_fn(
-                    {"params": params}, batch["x"], mutable="losses")
+                    {"params": params}, batch["x"], mutable="losses",
+                    **extra)
             aux = sum((leaf.sum() for leaf in
                        jax.tree.leaves(sown.get("losses", {}))),
                       start=jnp.float32(0.0))
