@@ -1,0 +1,89 @@
+"""Multi-worker TensorFlow MNIST — the reference's flagship example shape.
+
+Reference analogue: ``tony-examples/mnist-tensorflow`` (SURVEY.md §2.2,
+graduation configs ①/②): an actually-training TF job whose only wiring is
+the ``TF_CONFIG`` the TFRuntime injected. MultiWorkerMirroredStrategy
+forms its collective ring from that cluster spec; a custom ``strategy.run``
+loop (keras-3 ``fit`` no longer supports MWMS) trains a small conv net on
+MNIST-shaped data with the gradient allreduce crossing containers.
+
+Submit::
+
+    tony submit --framework tensorflow --src_dir examples \\
+        --executes "python tf_mnist_mwms.py" \\
+        --conf tony.worker.instances=2
+
+Uses synthetic MNIST-shaped data unless ``MNIST_NPZ`` points at the real
+arrays (keeps the example hermetic: the image has no dataset downloads).
+"""
+
+import json
+import os
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import numpy as np
+import tensorflow as tf
+
+
+def load_data(n=512):
+    path = os.environ.get("MNIST_NPZ")
+    if path and os.path.exists(path):
+        with np.load(path) as d:
+            return (d["x_train"][:n].reshape(-1, 28, 28, 1)
+                    .astype("float32") / 255.0,
+                    d["y_train"][:n].astype("int32"))
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(n, 28, 28, 1)).astype("float32")
+    ys = rng.integers(0, 10, size=(n,)).astype("int32")
+    return xs, ys
+
+
+def main():
+    tfc = json.loads(os.environ["TF_CONFIG"])
+    rank = tfc["task"]["index"]
+    n_workers = len(tfc["cluster"]["worker"])
+    strategy = tf.distribute.MultiWorkerMirroredStrategy()
+    assert strategy.num_replicas_in_sync == n_workers
+
+    xs, ys = load_data()
+    shard_x = tf.constant(xs[rank::n_workers])
+    shard_y = tf.constant(ys[rank::n_workers])
+
+    with strategy.scope():
+        model = tf.keras.Sequential([
+            tf.keras.layers.Conv2D(8, 3, activation="relu",
+                                   input_shape=(28, 28, 1)),
+            tf.keras.layers.MaxPooling2D(),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(10),
+        ])
+        opt = tf.keras.optimizers.SGD(0.05)
+        loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True)
+
+    @tf.function
+    def step():
+        def replica_step():
+            with tf.GradientTape() as tape:
+                loss = loss_fn(shard_y, model(shard_x, training=True))
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            return loss
+
+        per_replica = strategy.run(replica_step)
+        return strategy.reduce(tf.distribute.ReduceOp.MEAN, per_replica,
+                               axis=None)
+
+    losses = [float(step()) for _ in range(20)]
+    assert losses[-1] < losses[0], losses
+    print(f"worker {rank}/{n_workers}: loss {losses[0]:.4f} -> "
+          f"{losses[-1]:.4f}")
+    if rank == 0:
+        with open("tf_mnist_result.json", "w") as f:
+            json.dump({"losses": losses, "n_workers": n_workers}, f)
+
+
+if __name__ == "__main__":
+    main()

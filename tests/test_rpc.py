@@ -117,3 +117,20 @@ def test_finish_application_kills(server_and_session):
         c.call("finish_application", reason="user ctrl-c")
     assert session.job_status is JobStatus.KILLED
     assert all(t.status.value == "KILLED" for t in session.tasks())
+
+
+def test_call_timeout_override(server_and_session):
+    """Per-call _timeout clamps the retry window AND the in-flight socket
+    ops — deadline-driven loops (the executor gang barrier) must not block
+    a full default window past their own deadline."""
+    import time
+
+    server, handler, session = server_and_session
+    with RpcClient(server.address, timeout=60.0) as c:
+        assert c.call("get_cluster_spec", _timeout=5.0)["complete"] is False
+    # Unreachable address: the override bounds the total wall time.
+    dead = RpcClient("127.0.0.1:1", timeout=60.0)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        dead.call("heartbeat", _timeout=0.5, job_type="w", index=0)
+    assert time.monotonic() - t0 < 5.0

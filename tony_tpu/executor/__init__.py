@@ -360,8 +360,17 @@ class TaskExecutor:
         hb_thread.start()
         try:
             while True:
-                resp = self.client.call("get_cluster_spec")
-                if resp["complete"]:
+                # Clamp the RPC window to the barrier's remaining budget:
+                # with the client's default 60s retry window, one call
+                # begun just before the deadline could overshoot the gang
+                # timeout by a full minute.
+                remaining = max(0.5, deadline - time.monotonic())
+                try:
+                    resp = self.client.call("get_cluster_spec",
+                                            _timeout=min(10.0, remaining))
+                except (ConnectionError, OSError):
+                    resp = None  # transient; the deadline decides
+                if resp is not None and resp["complete"]:
                     cluster_spec = resp["spec"]
                     callback_info = resp.get("callback_info", {})
                     break

@@ -145,26 +145,37 @@ class RpcClient:
         per_op = min(cls.SOCKET_TIMEOUT_S, max(0.1, timeout))
         return timeout + 2.0 * per_op
 
-    def _connect(self) -> None:
+    def _connect(self, per_op: Optional[float] = None) -> None:
         self.close()
-        per_op = min(self.SOCKET_TIMEOUT_S, max(0.1, self.timeout))
+        if per_op is None:
+            per_op = min(self.SOCKET_TIMEOUT_S, max(0.1, self.timeout))
         self._sock = socket.create_connection(self._addr, timeout=per_op)
         self._file = self._sock.makefile("rwb")
 
-    def call(self, method: str, **params: Any) -> Any:
+    def call(self, method: str, _timeout: Optional[float] = None,
+             **params: Any) -> Any:
         """Invoke ``method`` remotely; retries transport errors until
-        ``timeout``, raises :class:`RpcError` on application errors."""
+        ``timeout`` (``_timeout`` overrides per call — deadline-driven
+        loops like the executor's gang barrier must not block a full
+        default window past their own deadline), raises :class:`RpcError`
+        on application errors."""
         req = {"method": method, "params": params}
         if self.token:
             req["token"] = self.token
         payload = (json.dumps(req) + "\n").encode()
-        deadline = time.monotonic() + self.timeout
+        effective = self.timeout if _timeout is None else _timeout
+        per_op = min(self.SOCKET_TIMEOUT_S, max(0.1, effective))
+        deadline = time.monotonic() + effective
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
             try:
                 with self._lock:
                     if self._file is None:
-                        self._connect()
+                        self._connect(per_op)
+                    elif self._sock is not None:
+                        # Re-arm the per-op cap: a persistent connection
+                        # keeps the timeout of the call that dialed it.
+                        self._sock.settimeout(per_op)
                     assert self._file is not None
                     self._file.write(payload)
                     self._file.flush()
@@ -183,7 +194,8 @@ class RpcClient:
                     self.close()
                 time.sleep(self.retry_interval)
         raise ConnectionError(
-            f"RPC {method} to {self._addr} failed after {self.timeout}s: {last_err}")
+            f"RPC {method} to {self._addr} failed after {effective}s: "
+            f"{last_err}")
 
     def close(self) -> None:
         if self._file is not None:

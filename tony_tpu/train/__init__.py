@@ -55,11 +55,10 @@ def chunked_next_token_xent(hidden: jax.Array, lm_head: jax.Array,
     rows = hidden[:, :-1].reshape(-1, d)
     labels = tokens[:, 1:].reshape(-1)
     r = rows.shape[0]
-    n = max(1, r // chunk)
-    if r % chunk:
+    n = -(-r // chunk)   # ceil: minimal whole-chunk cover
+    pad = n * chunk - r
+    if pad:
         # Pad to a whole number of chunks; padded rows get weight 0.
-        pad = n * chunk + chunk - r
-        n += 1
         rows = jnp.pad(rows, ((0, pad), (0, 0)))
         labels = jnp.pad(labels, (0, pad))
         weights = jnp.pad(jnp.ones((r,), jnp.float32), (0, pad))
