@@ -635,6 +635,31 @@ def test_tpuvm_staging_failure_fails_job_not_am(tpuvm):
     assert "staging" in diags and "failed" in diags
 
 
+def test_tpuvm_concurrent_gang_stages_each_host_once(tpuvm):
+    """The AM launches gangs concurrently (r4) and staging serializes PER
+    HOST: 4 workers on 2 hosts must stage conf+src exactly once per host —
+    no double transfers, no torn trees."""
+    log = tpuvm.fake.parent / "ssh_calls.log"
+    tpuvm.fake.write_text(
+        "#!/bin/sh\n"
+        f"echo \"$@\" >> {log}\n"
+        'shift\nexec sh -c "$*"\n')
+    job = tpuvm.pod.run(tpuvm.props(**{
+        "tony.worker.instances": "4",
+    }), src_dir=WORKLOADS, timeout=120)
+    assert job.exit_code == 0, job.session.final_message
+    calls = log.read_text().splitlines()
+    # Staging commands carry 'tar -xf' on the remote side; one conf + one
+    # src transfer per distinct host.
+    stage_calls = [c for c in calls if "tar -xf" in c]
+    per_host = {}
+    for c in stage_calls:
+        host = c.split()[0]
+        per_host[host] = per_host.get(host, 0) + 1
+    assert set(per_host) == {"127.0.0.1", "localhost"}, per_host
+    assert all(v == 2 for v in per_host.values()), per_host  # conf + src
+
+
 def test_tpuvm_jax_distributed_dp_training(tpuvm):
     """VERDICT r3 #4: the closest this environment gets to the v4-32 story —
     two 'hosts' behind the SSH substrate run REAL jax.distributed DP

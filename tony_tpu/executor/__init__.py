@@ -368,15 +368,18 @@ class TaskExecutor:
                 try:
                     resp = self.client.call("get_cluster_spec",
                                             _timeout=min(10.0, remaining))
-                except (ConnectionError, OSError):
-                    resp = None  # transient; the deadline decides
+                    last_err = None
+                except (ConnectionError, OSError) as e:
+                    resp, last_err = None, e  # transient; deadline decides
                 if resp is not None and resp["complete"]:
                     cluster_spec = resp["spec"]
                     callback_info = resp.get("callback_info", {})
                     break
                 if time.monotonic() > deadline:
+                    cause = f"; last RPC error: {last_err}" if last_err \
+                        else ""
                     print(f"[tony-executor] gang barrier timed out after "
-                          f"{gang_timeout_s:.0f}s", file=sys.stderr)
+                          f"{gang_timeout_s:.0f}s{cause}", file=sys.stderr)
                     return constants.EXIT_FAILURE
                 time.sleep(0.1)
             # 5. build env + localize.

@@ -137,18 +137,23 @@ class RpcClient:
     SOCKET_TIMEOUT_S = 10.0
 
     @classmethod
+    def _per_op(cls, timeout: float) -> float:
+        """Single-op (connect/recv) cap for a call with this retry window
+        — THE one definition; worst_case_call_s/_connect/call all use it."""
+        return min(cls.SOCKET_TIMEOUT_S, max(0.1, timeout))
+
+    @classmethod
     def worst_case_call_s(cls, timeout: float) -> float:
         """Upper bound on one :meth:`call`'s wall time: the retry window,
         plus one last attempt begun just before the deadline that blocks
         for a full socket connect + recv. The client's AM-relaunch grace
-        is derived from this — keep it in sync with call()/_connect()."""
-        per_op = min(cls.SOCKET_TIMEOUT_S, max(0.1, timeout))
-        return timeout + 2.0 * per_op
+        is derived from this."""
+        return timeout + 2.0 * cls._per_op(timeout)
 
     def _connect(self, per_op: Optional[float] = None) -> None:
         self.close()
         if per_op is None:
-            per_op = min(self.SOCKET_TIMEOUT_S, max(0.1, self.timeout))
+            per_op = self._per_op(self.timeout)
         self._sock = socket.create_connection(self._addr, timeout=per_op)
         self._file = self._sock.makefile("rwb")
 
@@ -164,7 +169,7 @@ class RpcClient:
             req["token"] = self.token
         payload = (json.dumps(req) + "\n").encode()
         effective = self.timeout if _timeout is None else _timeout
-        per_op = min(self.SOCKET_TIMEOUT_S, max(0.1, effective))
+        per_op = self._per_op(effective)
         deadline = time.monotonic() + effective
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
