@@ -164,6 +164,47 @@ def test_am_sigterm_graceful_teardown(tmp_path):
             client.am_proc.kill()
 
 
+def test_cli_kill_and_logs(tmp_path, capsys):
+    """`tony kill` (yarn application -kill analogue) reaches a detached
+    job's AM via finish_application; `tony logs` prints container logs."""
+    import time
+
+    workdir = tmp_path / "jobs"
+    client = TonyClient(
+        TonyConfig(base_props(**{
+            "tony.application.executes": "python forever.py"})),
+        src_dir=WORKLOADS, workdir=workdir, stream=io.StringIO())
+    client.submit()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and not (client.job_dir / "am.address").is_file():
+            time.sleep(0.1)
+        assert (client.job_dir / "am.address").is_file()
+        assert cli_main(["kill", client.app_id,
+                         "--workdir", str(workdir),
+                         "--reason", "cli-test"]) == 0
+        assert client.monitor(timeout=60) == 1
+        assert client.final_status == "KILLED"
+        assert "tony kill" in client.final_message
+    finally:
+        if client.am_proc and client.am_proc.poll() is None:
+            client.am_proc.kill()
+
+    done = run_client(tmp_path, **{
+        "tony.application.executes": "python -c 'print(\"log-marker\")'"})
+    assert done.exit_code == 0
+    assert cli_main(["logs", done.app_id, "--workdir",
+                     str(tmp_path / "jobs"), "--tail", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "log-marker" in out and "stdout.log" in out
+    # Unknown app id fails loudly.
+    assert cli_main(["logs", "app_nope", "--workdir",
+                     str(tmp_path / "jobs")]) == 1
+    assert cli_main(["kill", "app_nope", "--workdir",
+                     str(tmp_path / "jobs")]) == 1
+
+
 # -- history ---------------------------------------------------------------
 
 def test_history_list_show_and_portal(tmp_path):

@@ -87,21 +87,18 @@ def cmd_profile(args: argparse.Namespace) -> int:
 
     from tony_tpu import constants
     from tony_tpu.profiler import collect_traces, endpoints_from_callback_info
-    from tony_tpu.rpc import RpcClient
-    from tony_tpu.util import default_workdir
+    from tony_tpu.rpc import RpcClient, RpcError
 
-    workdir = Path(args.workdir) if args.workdir else default_workdir()
-    job_dir = workdir / args.app_id
-    addr_file = job_dir / "am.address"
-    if not addr_file.is_file():
-        print(f"no live AM address for {args.app_id} under {workdir} "
-              f"(is the job running?)")
+    live = _live_am(args)
+    if live is None:
         return 1
-    token_file = job_dir / "am.token"
-    token = token_file.read_text().strip() if token_file.is_file() else None
-    with RpcClient(addr_file.read_text().strip(), token=token,
-                   timeout=10.0) as c:
-        info = c.call("get_task_callback_info")
+    job_dir, addr, token = live
+    try:
+        with RpcClient(addr, token=token, timeout=10.0) as c:
+            info = c.call("get_task_callback_info")
+    except (RpcError, OSError) as e:
+        print(f"AM RPC failed: {e}")
+        return 1
     endpoints = endpoints_from_callback_info(info)
     if not endpoints:
         print("no profiler endpoints registered — set "
@@ -117,6 +114,81 @@ def cmd_profile(args: argparse.Namespace) -> int:
     collected = collect_traces(endpoints, history, args.app_id,
                                duration_ms=args.duration_ms)
     return 0 if collected else 1
+
+
+def _job_dir_of(args: argparse.Namespace):
+    from pathlib import Path
+
+    from tony_tpu.util import default_workdir
+
+    workdir = Path(args.workdir) if args.workdir else default_workdir()
+    return workdir / args.app_id
+
+
+def _live_am(args: argparse.Namespace):
+    """(job_dir, am_address, token) of a RUNNING job, or None (reported)
+    — the shared resolution for every verb that dials a live AM."""
+    job_dir = _job_dir_of(args)
+    addr_file = job_dir / "am.address"
+    if not addr_file.is_file():
+        print(f"no live AM address for {args.app_id} under "
+              f"{job_dir.parent} (already finished, or wrong --workdir?)")
+        return None
+    token_file = job_dir / "am.token"
+    try:
+        token = token_file.read_text().strip() \
+            if token_file.is_file() else None
+        addr = addr_file.read_text().strip()
+    except OSError as e:   # e.g. 0600 token owned by the submitter
+        print(f"cannot read AM credentials under {job_dir}: {e}")
+        return None
+    return job_dir, addr, token
+
+
+def cmd_kill(args: argparse.Namespace) -> int:
+    """Kill a RUNNING job from outside its submitting client (reference
+    analogue: ``yarn application -kill``)."""
+    from tony_tpu.rpc import RpcClient, RpcError
+
+    live = _live_am(args)
+    if live is None:
+        return 1
+    _, addr, token = live
+    try:
+        with RpcClient(addr, token=token, timeout=10.0) as c:
+            c.call("finish_application",
+                   reason=f"killed via tony kill by {args.reason or 'cli'}")
+    except (RpcError, OSError) as e:
+        print(f"kill RPC failed: {e}")
+        return 1
+    print(f"kill requested for {args.app_id}")
+    return 0
+
+
+def cmd_logs(args: argparse.Namespace) -> int:
+    """Print per-container logs of a job on the local substrate
+    (reference analogue: ``yarn logs -applicationId``). Remote (tpu-vm)
+    containers keep their logs on the worker hosts."""
+    job_dir = _job_dir_of(args)
+    containers = sorted((job_dir / "containers").glob("*")) \
+        if (job_dir / "containers").is_dir() else []
+    if not containers:
+        print(f"no container logs under {job_dir} "
+              f"(wrong --workdir, or a remote-substrate job?)")
+        return 1
+    tail = args.tail
+    for cdir in containers:
+        for name in ("executor.log", "stdout.log", "stderr.log"):
+            f = cdir / name
+            if not f.is_file() or f.stat().st_size == 0:
+                continue
+            lines = f.read_text(errors="replace").splitlines()
+            shown = lines[-tail:] if tail else lines
+            print(f"===== {cdir.name}/{name} "
+                  f"({len(lines)} lines{f', last {len(shown)}' if tail else ''}) =====")
+            for line in shown:
+                print(line)
+    return 0
 
 
 def cmd_version(_args: argparse.Namespace) -> int:
@@ -187,6 +259,21 @@ def make_parser() -> argparse.ArgumentParser:
     pr.add_argument("--duration_ms", type=int, default=2000,
                     help="trace capture window per rank")
     pr.set_defaults(fn=cmd_profile)
+
+    k = sub.add_parser("kill", help="kill a running job (yarn "
+                       "application -kill analogue)")
+    k.add_argument("app_id", help="application id of a RUNNING job")
+    k.add_argument("--workdir", help="client work dir (default ~/.tony-tpu/jobs)")
+    k.add_argument("--reason", help="recorded in the job's final message")
+    k.set_defaults(fn=cmd_kill)
+
+    lg = sub.add_parser("logs", help="print per-container logs "
+                        "(yarn logs analogue, local substrate)")
+    lg.add_argument("app_id", help="application id")
+    lg.add_argument("--workdir", help="client work dir (default ~/.tony-tpu/jobs)")
+    lg.add_argument("--tail", type=int, default=0,
+                    help="only the last N lines of each log (0 = all)")
+    lg.set_defaults(fn=cmd_logs)
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=cmd_version)
