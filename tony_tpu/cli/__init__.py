@@ -122,7 +122,10 @@ def _job_dir_of(args: argparse.Namespace):
     from tony_tpu.util import default_workdir
 
     workdir = Path(args.workdir) if args.workdir else default_workdir()
-    return workdir / args.app_id
+    # Resolved: the trace logdir travels inside the profiler RPC and the
+    # SERVER (the profiled process, different cwd) may write the xplane
+    # files itself — a relative path lands in the wrong tree.
+    return (workdir / args.app_id).resolve()
 
 
 def _live_am(args: argparse.Namespace):
@@ -169,6 +172,10 @@ def cmd_logs(args: argparse.Namespace) -> int:
     """Print per-container logs of a job on the local substrate
     (reference analogue: ``yarn logs -applicationId``). Remote (tpu-vm)
     containers keep their logs on the worker hosts."""
+    from collections import deque
+
+    from tony_tpu import constants
+
     job_dir = _job_dir_of(args)
     containers = sorted((job_dir / "containers").glob("*")) \
         if (job_dir / "containers").is_dir() else []
@@ -178,16 +185,18 @@ def cmd_logs(args: argparse.Namespace) -> int:
         return 1
     tail = args.tail
     for cdir in containers:
-        for name in ("executor.log", "stdout.log", "stderr.log"):
+        for name in (constants.EXECUTOR_LOG_NAME,
+                     constants.USER_STDOUT_NAME, constants.USER_STDERR_NAME):
             f = cdir / name
             if not f.is_file() or f.stat().st_size == 0:
                 continue
-            lines = f.read_text(errors="replace").splitlines()
-            shown = lines[-tail:] if tail else lines
-            print(f"===== {cdir.name}/{name} "
-                  f"({len(lines)} lines{f', last {len(shown)}' if tail else ''}) =====")
+            with open(f, errors="replace") as fh:
+                # Bounded: a long-running job's logs can be GBs.
+                shown = deque(fh, maxlen=tail) if tail else list(fh)
+            print(f"===== {cdir.name}/{name}"
+                  f"{f' (last {len(shown)} lines)' if tail else ''} =====")
             for line in shown:
-                print(line)
+                print(line.rstrip("\n"))
     return 0
 
 

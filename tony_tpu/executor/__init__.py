@@ -343,6 +343,11 @@ class TaskExecutor:
         if adapter.need_reserve_tb_port(pre_ctx):
             tb_sock = reserve_port()
             tb_port = tb_sock.getsockname()[1]
+        prof_sock = None
+        prof_port = None
+        if adapter.need_reserve_profiler_port(pre_ctx):
+            prof_sock = reserve_port()
+            prof_port = prof_sock.getsockname()[1]
         # 3. register.
         self.client.call("register_worker_spec", job_type=self.job_type,
                          index=self.index, host=self.host, port=port)
@@ -387,6 +392,7 @@ class TaskExecutor:
                               index=self.index, cluster_spec=cluster_spec,
                               am_address=self.am_address, app_id=self.app_id,
                               attempt_id=self.attempt_id, tb_port=tb_port,
+                              profiler_port=prof_port,
                               callback_info=callback_info)
             adapter.validate(ctx)
             task_env = adapter.build_task_env(ctx)
@@ -410,6 +416,8 @@ class TaskExecutor:
             rendezvous_sock.close()
             if tb_sock is not None:
                 tb_sock.close()
+            if prof_sock is not None:
+                prof_sock.close()
             stdout = open(self.log_dir / constants.USER_STDOUT_NAME, "ab")
             stderr = open(self.log_dir / constants.USER_STDERR_NAME, "ab")
             # Stays in the executor's process group on purpose: the
@@ -481,7 +489,7 @@ class TaskExecutor:
             return exit_code
         finally:
             self._hb_stop.set()
-            for s in (rendezvous_sock, tb_sock):
+            for s in (rendezvous_sock, tb_sock, prof_sock):
                 if s is not None:
                     try:
                         s.close()

@@ -127,18 +127,29 @@ def collect_traces(endpoints: Dict[str, str], history_dir: str | Path,
                 f"endpoint not reachable within {wait_reachable_s:.0f}s")
     if not live:
         return []
-    dest = traces_root(history_dir, app_id)
+    # Absolute: the logdir travels inside the profiler RPC and the SERVER
+    # (the profiled process, different cwd) writes the xplane files — a
+    # relative path silently lands in (or fails under) the wrong tree.
+    dest = traces_root(history_dir, app_id).resolve()
     dest.mkdir(parents=True, exist_ok=True)
     (dest / "manifest.json").write_text(json.dumps(live, sort_keys=True))
-    try:
-        capture(",".join(live.values()), str(dest), duration_ms)
-    except Exception as e:  # noqa: BLE001 — profiling is advisory
-        log(f"trace capture from {sorted(live)} failed: {e}")
-        return []
-    if any(p.suffix == ".pb" for p in dest.rglob("*")):
-        log(f"synchronized trace from {sorted(live)} -> {dest}")
-        return [dest]
-    log(f"trace capture from {sorted(live)} produced no files")
+    # A capture landing in a dead window (the job mid-compile, between
+    # steps) legitimately returns zero events; retry a couple of times
+    # before giving up — the operator asked for a trace, not for luck.
+    import time
+    for attempt in range(3):
+        try:
+            capture(",".join(live.values()), str(dest), duration_ms)
+        except Exception as e:  # noqa: BLE001 — profiling is advisory
+            log(f"trace capture from {sorted(live)} failed: {e}")
+            return []
+        if any(p.suffix == ".pb" for p in dest.rglob("*")):
+            log(f"synchronized trace from {sorted(live)} -> {dest}")
+            return [dest]
+        log(f"trace capture from {sorted(live)} produced no events "
+            f"(attempt {attempt + 1}/3; job idle or compiling?)")
+        if attempt < 2:
+            time.sleep(2.0)
     return []
 
 

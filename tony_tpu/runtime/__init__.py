@@ -42,6 +42,7 @@ class TaskContext:
     app_id: str
     attempt_id: int = 1
     tb_port: Optional[int] = None
+    profiler_port: Optional[int] = None     # executor-reserved, ephemeral
     callback_info: Dict[str, str] = field(default_factory=dict)  # AM-pushed extras
 
     # -- derived helpers shared by adapters --------------------------------
@@ -131,6 +132,13 @@ class TaskExecutorAdapter:
         return ctx.job_type in (constants.TENSORBOARD, constants.NOTEBOOK) or (
             ctx.job_type in constants.CHIEF_LIKE_JOB_TYPES and
             constants.TENSORBOARD not in ctx.job_types())
+
+    def need_reserve_profiler_port(self, ctx: TaskContext) -> bool:
+        """Whether the executor should reserve an ephemeral profiler port
+        for this task. Ephemeral, not conf-fixed: a fixed port-base
+        collides whenever two jobs (or a dying predecessor's user process)
+        share a host — the trace client then dials the wrong server."""
+        return False
 
     def build_task_env(self, ctx: TaskContext) -> Dict[str, str]:
         """Rendezvous env for the user process. Subclasses extend."""

@@ -29,6 +29,10 @@ from tony_tpu.runtime.base import MLGenericTaskAdapter
 
 
 class JAXTaskAdapter(MLGenericTaskAdapter):
+    def need_reserve_profiler_port(self, ctx: TaskContext) -> bool:
+        return (not ctx.is_sidecar()
+                and ctx.conf.get_bool("tony.task.profiler.enabled", False))
+
     def framework_env(self, ctx: TaskContext) -> Dict[str, str]:
         if ctx.is_sidecar():
             # Sidecars (tensorboard/notebook/driver) are not part of the SPMD
@@ -63,10 +67,13 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
         env[constants.ENV_TPU_WORKER_ID] = str(rank)
         env[constants.ENV_TPU_WORKER_HOSTNAMES] = ",".join(hosts)
         # Profiler hook (SURVEY.md §5.1): tony_tpu.distributed.initialize
-        # starts jax.profiler.start_server on this port in the user process.
-        if ctx.conf.get_bool("tony.task.profiler.enabled", False):
-            base = ctx.conf.get_int("tony.task.profiler.port-base", 9431)
-            env[constants.ENV_PROFILER_PORT] = str(base + rank)
+        # starts jax.profiler.start_server on this port in the user
+        # process. The port is executor-reserved and EPHEMERAL (shipped to
+        # the AM via register_callback_info) — a conf-fixed base+rank
+        # collided across overlapping jobs on one host, and the trace
+        # client would dial a dying predecessor's server.
+        if ctx.profiler_port is not None:
+            env[constants.ENV_PROFILER_PORT] = str(ctx.profiler_port)
         return env
 
 
