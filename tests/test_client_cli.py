@@ -205,6 +205,54 @@ def test_cli_kill_and_logs(tmp_path, capsys):
                      str(tmp_path / "jobs")]) == 1
 
 
+def test_cli_profile_captures_trace(tmp_path, monkeypatch):
+    """`tony profile` against a detached RUNNING job: endpoint fetched over
+    the new get_task_callback_info verb, synchronized capture into the
+    history dir. Relative --workdir on purpose — the logdir travels inside
+    the profiler RPC and the server writes the xplane from a different
+    cwd (the round-4 live bug)."""
+    import time
+
+    monkeypatch.chdir(tmp_path)
+    src = Path("src")
+    src.mkdir()
+    (src / "profiled_train.py").write_text(
+        (WORKLOADS / "profiled_train.py").read_text())
+    client = TonyClient(
+        TonyConfig(base_props(**{
+            "tony.application.framework": "jax",
+            "tony.application.executes": "python profiled_train.py",
+            "tony.task.profiler.enabled": "true",
+            "tony.task.max-missed-heartbeats": "200"})),
+        src_dir=src, workdir=Path("jobs"), stream=io.StringIO())
+    client.submit()
+    try:
+        from tony_tpu.rpc import RpcClient
+        deadline = time.monotonic() + 60
+        endpoint_seen = False
+        while time.monotonic() < deadline and not endpoint_seen:
+            addr_file = client.job_dir / "am.address"
+            if addr_file.is_file():
+                try:
+                    with RpcClient(addr_file.read_text().strip(),
+                                   timeout=5) as c:
+                        endpoint_seen = bool(
+                            c.call("get_task_callback_info"))
+                except Exception:
+                    pass
+            time.sleep(0.25)
+        assert endpoint_seen, "profiler endpoint never registered"
+        assert cli_main(["profile", client.app_id, "--workdir", "jobs",
+                         "--duration_ms", "1000"]) == 0
+        traces = list((client.job_dir / "history" / "traces").rglob("*.pb"))
+        assert traces and traces[0].stat().st_size > 0
+    finally:
+        cli_main(["kill", client.app_id, "--workdir", "jobs"])
+        client.monitor(timeout=60)
+        if client.am_proc and client.am_proc.poll() is None:
+            client.am_proc.kill()
+
+
 # -- history ---------------------------------------------------------------
 
 def test_history_list_show_and_portal(tmp_path):

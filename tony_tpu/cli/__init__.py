@@ -183,20 +183,26 @@ def cmd_logs(args: argparse.Namespace) -> int:
         print(f"no container logs under {job_dir} "
               f"(wrong --workdir, or a remote-substrate job?)")
         return 1
-    tail = args.tail
+    tail = max(0, args.tail)
     for cdir in containers:
         for name in (constants.EXECUTOR_LOG_NAME,
                      constants.USER_STDOUT_NAME, constants.USER_STDERR_NAME):
             f = cdir / name
             if not f.is_file() or f.stat().st_size == 0:
                 continue
+            # Bounded memory either way: deque for --tail, streamed
+            # line-by-line otherwise — container logs can be GBs.
             with open(f, errors="replace") as fh:
-                # Bounded: a long-running job's logs can be GBs.
-                shown = deque(fh, maxlen=tail) if tail else list(fh)
-            print(f"===== {cdir.name}/{name}"
-                  f"{f' (last {len(shown)} lines)' if tail else ''} =====")
-            for line in shown:
-                print(line.rstrip("\n"))
+                if tail:
+                    shown = deque(fh, maxlen=tail)
+                    print(f"===== {cdir.name}/{name} "
+                          f"(last {len(shown)} lines) =====")
+                    for line in shown:
+                        print(line.rstrip("\n"))
+                else:
+                    print(f"===== {cdir.name}/{name} =====")
+                    for line in fh:
+                        print(line.rstrip("\n"))
     return 0
 
 

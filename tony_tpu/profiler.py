@@ -136,14 +136,17 @@ def collect_traces(endpoints: Dict[str, str], history_dir: str | Path,
     # A capture landing in a dead window (the job mid-compile, between
     # steps) legitimately returns zero events; retry a couple of times
     # before giving up — the operator asked for a trace, not for luck.
+    # Success means a NEW xplane file: .pb files from an earlier capture
+    # into the same dest must not mask an empty session.
     import time
+    before = {p for p in dest.rglob("*") if p.suffix == ".pb"}
     for attempt in range(3):
         try:
             capture(",".join(live.values()), str(dest), duration_ms)
         except Exception as e:  # noqa: BLE001 — profiling is advisory
             log(f"trace capture from {sorted(live)} failed: {e}")
             return []
-        if any(p.suffix == ".pb" for p in dest.rglob("*")):
+        if {p for p in dest.rglob("*") if p.suffix == ".pb"} - before:
             log(f"synchronized trace from {sorted(live)} -> {dest}")
             return [dest]
         log(f"trace capture from {sorted(live)} produced no events "
