@@ -91,7 +91,11 @@ def main() -> int:
     # runs the BN reductions at/below the standalone-kernel HBM-pass
     # lower bound, so the fused path stays flag-gated off.
     fused_bn = os.environ.get("BENCH_FUSED_BN", "0") == "1"
-    model = get_model("resnet50", fused_bn=fused_bn)
+    # MLPerf-standard space-to-depth stem (r5): mathematically equivalent
+    # 4x4/s1 stem on the 112²x12 packing. Measured on v5e at batch 384:
+    # see exp/s2d_results.txt and README round-5 notes.
+    s2d = os.environ.get("BENCH_S2D", "1") == "1"
+    model = get_model("resnet50", fused_bn=fused_bn, s2d_stem=s2d)
     kx, ky, kinit = jax.random.split(jax.random.PRNGKey(0), 3)
     x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
     y = jax.random.randint(ky, (batch,), 0, 1000)
@@ -175,9 +179,13 @@ def bench_llm(peak: float) -> dict:
     batch = int(os.environ.get("BENCH_LLM_BATCH", "32"))
     seq = int(os.environ.get("BENCH_LLM_SEQ", "512"))
     heads = int(os.environ.get("BENCH_LLM_HEADS", "8"))
+    # GQA (zero-copy through the flash kernels' index maps — r5):
+    # n_kv_heads < n_heads shrinks K/V projections and kernel KV traffic.
+    kv_heads = int(os.environ.get("BENCH_LLM_KV_HEADS", str(heads)))
     dim = int(os.environ.get("BENCH_LLM_DIM", "1024"))
     ffn = int(os.environ.get("BENCH_LLM_FFN", "4096"))
     layers = int(os.environ.get("BENCH_LLM_LAYERS", "12"))
+    vocab = int(os.environ.get("BENCH_LLM_VOCAB", "32768"))
     remat = os.environ.get("BENCH_LLM_REMAT", "0") == "1"
     scan_layers = os.environ.get("BENCH_LLM_SCAN", "0") == "1"
     # Row-chunked fused head+CE (train.chunked_next_token_xent): the
@@ -186,7 +194,7 @@ def bench_llm(peak: float) -> dict:
     xent_chunk = int(os.environ.get("BENCH_LLM_XENT_CHUNK", "0"))
     model = get_model(
         "llama2-7b", dim=dim, n_layers=layers, n_heads=heads,
-        n_kv_heads=heads, ffn_hidden=ffn, vocab=32768, max_seq=seq,
+        n_kv_heads=kv_heads, ffn_hidden=ffn, vocab=vocab, max_seq=seq,
         attention=os.environ.get("BENCH_LLM_ATTN", "flash"),
         scan_layers=scan_layers, remat=remat, xent_chunk=xent_chunk)
     cfg = model.cfg

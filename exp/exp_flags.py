@@ -1,0 +1,28 @@
+"""Can TPU backend compiler options reach the remote compiler? Probe with a
+tiny jit, then measure the ResNet window under candidate options."""
+import functools, sys, time
+import jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+
+opts = {}
+if len(sys.argv) > 1 and sys.argv[1] != "none":
+    k, _, v = sys.argv[1].partition("=")
+    opts[k] = v
+
+f = jax.jit(lambda x: x @ x, compiler_options=opts or None)
+print("probe ok:", f(jnp.ones((256, 256), jnp.bfloat16)).shape, opts, flush=True)
+
+from exp_profile_resnet import build_window  # noqa: E402
+
+window, carry = build_window(steps=20)
+if opts:
+    window = jax.jit(window.__wrapped__, donate_argnums=(0,),
+                     compiler_options=opts)
+carry, loss = window(carry); float(loss)
+carry, loss = window(carry); float(loss)
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    carry, loss = window(carry); float(loss)
+    best = min(best, time.perf_counter() - t0)
+print(f"{best/20*1e3:.2f} ms/step under {opts}", flush=True)

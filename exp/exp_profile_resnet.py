@@ -1,0 +1,98 @@
+"""Capture a device trace of the ResNet bench step and print the top ops
+by self time (round-5 evidence base for the conv-efficiency attack)."""
+import functools
+import glob
+import gzip
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def build_window(batch=384, image=224, steps=5, fused_bn=False, s2d=False):
+    import optax
+    from tony_tpu.models import get_model
+    from tony_tpu import train as tr
+
+    model = get_model("resnet50", fused_bn=fused_bn, **(
+        {"s2d_stem": True} if s2d else {}))
+    kx, ky, kinit = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
+    y = jax.random.randint(ky, (batch,), 0, 1000)
+    variables = jax.jit(lambda: model.init(kinit, x, train=False))()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+
+    def step(carry, _):
+        params, opt_state, batch_stats = carry
+
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return tr.cross_entropy_loss(logits, y), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, new_stats), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def window(carry):
+        carry, losses = jax.lax.scan(step, carry, None, length=steps)
+        return carry, losses[-1]
+
+    return window, (params, opt_state, batch_stats)
+
+
+def parse_xplane(logdir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not files:
+        print("no xplane files under", logdir)
+        return
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(sorted(files)[-1], "rb").read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "Device" not in plane.name:
+            continue
+        evmeta = {m.id: m.name for m in plane.event_metadata.values()}
+        totals = {}
+        for line in plane.lines:
+            for ev in line.events:
+                name = evmeta.get(ev.metadata_id, "?")
+                totals[name] = totals.get(name, 0) + ev.duration_ps
+        total = sum(totals.values())
+        print(f"== plane {plane.name}: {total/1e12*1e3:.1f} ms total")
+        for name, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:40]:
+            print(f"  {ps/1e9:9.3f} ms {100*ps/total:5.1f}%  {name[:110]}")
+
+
+def main():
+    steps = 5
+    window, carry = build_window(steps=steps,
+                                 s2d=os.environ.get("S2D", "0") == "1")
+    carry, loss = window(carry)
+    float(loss)
+    carry, loss = window(carry)
+    float(loss)
+    logdir = os.path.abspath(os.environ.get("TRACE_DIR", "exp/trace_r5"))
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    t0 = time.perf_counter()
+    carry, loss = window(carry)
+    float(loss)
+    dt = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+    print(f"window: {dt*1e3:.1f} ms wall, {dt/steps*1e3:.1f} ms/step")
+    parse_xplane(logdir)
+
+
+if __name__ == "__main__":
+    main()

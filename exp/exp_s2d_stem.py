@@ -1,0 +1,121 @@
+"""Round-5 experiment: space-to-depth stem (VERDICT r4 next-step #1).
+
+Measures, on the real chip, fwd+bwd time of:
+  1. the baseline 7x7/s2 stem conv on [N,224,224,3]
+  2. the s2d-equivalent 4x4/s1 conv on [N,112,112,12] (s2d inside the graph)
+  3. same but input pre-packed as [N,112,112,12] (s2d done by the data
+     pipeline, as MLPerf submissions do)
+  4. bandwidth probe: elementwise pass over [N,224,224,3] vs [N,112,112,12]
+     vs [N,224,224,128] to expose physical lane padding of tiny-C tensors.
+
+Protocol: jitted scan windows, device->host fenced, best-of-3 (ROOFLINE.md).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+N = 384
+STEPS = 20
+
+
+def timeit(window, carry):
+    carry, out = window(carry)
+    float(out.ravel()[0])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        carry, out = window(carry)
+        float(out.ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / STEPS
+
+
+def bench_fwd_bwd(f, params, x):
+    """best-of-3 per-step time of value_and_grad(f)(params, x) in a scan."""
+    def loss(p):
+        return jnp.sum(f(p, x).astype(jnp.float32) * 1e-6)
+
+    def step(p, _):
+        l, g = jax.value_and_grad(loss)(p)
+        p = jax.tree_util.tree_map(lambda a, b: a - 1e-9 * b, p, g)
+        return p, l
+
+    @jax.jit
+    def window(p):
+        p, ls = jax.lax.scan(step, p, None, length=STEPS)
+        return p, ls[-1]
+
+    return timeit(window, params)
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    results = {}
+
+    # -- bandwidth probes: one read+write pass over each tensor ------------
+    for name, shape in [("copy_224x3", (N, 224, 224, 3)),
+                        ("copy_112x12", (N, 112, 112, 12)),
+                        ("copy_112x1344_packed", (N, 112, 1344)),
+                        ("copy_56x64", (N, 56, 56, 64)),
+                        ("copy_56x56x64_as_3584", (N, 56, 3584))]:
+        x = jax.random.normal(k, shape, jnp.bfloat16)
+
+        def step(c, _, x=x):
+            return c, jnp.sum(x * c)
+
+        @jax.jit
+        def window(c, step=step):
+            c, ls = jax.lax.scan(step, c, None, length=STEPS)
+            return c, ls[-1]
+
+        t = timeit(window, jnp.bfloat16(1.0))
+        import numpy as np
+        logical_gb = float(np.prod(shape)) * 2 / 1e9
+        print(f"{name:28s} {t*1e3:8.3f} ms/step  "
+              f"{logical_gb/t:7.0f} GB/s logical", flush=True)
+
+    # -- stem variants -----------------------------------------------------
+    import flax.linen as nn
+
+    class Stem(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                           use_bias=False, dtype=jnp.bfloat16)(x)
+
+    class S2dStem(nn.Module):
+        pack: bool = False  # input already [N,112,112,12]
+
+        @nn.compact
+        def __call__(self, x):
+            if not self.pack:
+                n, h, w, c = x.shape
+                x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+                x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                    n, h // 2, w // 2, 4 * c)
+            return nn.Conv(64, (4, 4), (1, 1), padding=[(2, 1), (2, 1)],
+                           use_bias=False, dtype=jnp.bfloat16)(x)
+
+    x224 = jax.random.normal(k, (N, 224, 224, 3), jnp.bfloat16)
+    x112 = jax.random.normal(k, (N, 112, 112, 12), jnp.bfloat16)
+
+    m = Stem()
+    p = jax.jit(m.init)(k, x224)
+    print(f"{'stem_7x7':28s} {bench_fwd_bwd(m.apply, p, x224)*1e3:8.3f} "
+          "ms/step", flush=True)
+
+    m = S2dStem()
+    p = jax.jit(m.init)(k, x224)
+    print(f"{'stem_s2d_ingraph':28s} "
+          f"{bench_fwd_bwd(m.apply, p, x224)*1e3:8.3f} ms/step", flush=True)
+
+    m = S2dStem(pack=True)
+    p = jax.jit(m.init)(k, x112)
+    print(f"{'stem_s2d_packed':28s} "
+          f"{bench_fwd_bwd(m.apply, p, x112)*1e3:8.3f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

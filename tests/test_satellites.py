@@ -196,6 +196,11 @@ def test_tpuvm_chip_accounting_and_venv_rewrite(tmp_path):
     argv = sched.build_remote_command(ContainerLaunch(
         job_type="w", index=0, env={"TONY_VENV": str(venv_zip)}), "a")
     assert "export TONY_VENV=/tmp/tt/venv-stage/venv.tar.gz;" in argv[2]
+    # tony.containers.resources: the staged dir rewrites to the worker copy.
+    argv = sched.build_remote_command(ContainerLaunch(
+        job_type="w", index=0,
+        env={"TONY_RESOURCES_DIR": str(tmp_path)}), "a")
+    assert "export TONY_RESOURCES_DIR=/tmp/tt/resources;" in argv[2]
 
 
 def test_docker_wrap_command_unit():

@@ -1,0 +1,58 @@
+"""CredentialProvider SPI unit tests (reference tier: the token plumbing
+checks in TestTonyClient / TestUtils — SURVEY.md §2.1 Security)."""
+
+import json
+
+import pytest
+
+from tony_tpu import security
+from tony_tpu.conf import TonyConfig
+from tony_tpu.rpc import ENV_JOB_TOKEN
+
+
+def test_default_provider_is_token():
+    p = security.provider_for(TonyConfig())
+    assert isinstance(p, security.TokenCredentialProvider)
+    creds = p.acquire(TonyConfig(), None)
+    assert len(creds["token"]) == 32
+    # Default executor env ships exactly the RPC token.
+    assert p.executor_env(creds) == {ENV_JOB_TOKEN: creds["token"]}
+    # Default refresh keeps the credential map.
+    assert p.refresh(TonyConfig(), None, creds) is None
+
+
+def test_provider_spec_validation():
+    with pytest.raises(ValueError, match="module:Class"):
+        security.provider_for(TonyConfig(
+            {security.CREDENTIAL_PROVIDER: "not-a-path"}))
+    with pytest.raises(ModuleNotFoundError):
+        security.provider_for(TonyConfig(
+            {security.CREDENTIAL_PROVIDER: "no_such_mod:Provider"}))
+    with pytest.raises(TypeError, match="CredentialProvider"):
+        # An importable class that is not a provider must be rejected.
+        security.provider_for(TonyConfig(
+            {security.CREDENTIAL_PROVIDER: "pathlib:Path"}))
+
+
+def test_credentials_file_roundtrip(tmp_path):
+    path = security.write_credentials(tmp_path, {"token": "t", "x": "1"})
+    assert path.stat().st_mode & 0o777 == 0o600
+    assert security.read_credentials(tmp_path) == {"token": "t", "x": "1"}
+    assert json.loads(path.read_text())["x"] == "1"
+
+
+def test_read_credentials_absent(tmp_path):
+    assert security.read_credentials(tmp_path) is None
+
+
+def test_am_rejects_tokenless_provider(tmp_path):
+    """security.enabled with a provider that ships no 'token' must fail
+    loudly at AM construction — never an unauthenticated RPC surface."""
+    from tony_tpu.am import ApplicationMaster
+
+    security.write_credentials(tmp_path, {"cert": "pem-bytes"})
+    with pytest.raises(ValueError, match="no 'token'"):
+        ApplicationMaster(
+            TonyConfig({"tony.worker.instances": "1",
+                        "tony.security.enabled": "true"}),
+            app_id="app_x", job_dir=tmp_path)

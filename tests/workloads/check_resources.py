@@ -1,0 +1,15 @@
+"""Asserts tony.containers.resources entries were localized into the
+container cwd (reference fixture role: check_env_and_venv.py for
+LocalizableResource): a plain file, a directory, and an unpacked #archive
+member. Writes what it saw for the test to inspect."""
+import json
+import sys
+from pathlib import Path
+
+seen = {
+    "data": Path("data.txt").read_text().strip(),
+    "dir_member": Path("extra/nested.txt").read_text().strip(),
+    "archive_member": Path("inside_archive.txt").read_text().strip(),
+}
+Path("resources_check.json").write_text(json.dumps(seen))
+sys.exit(0)

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import json
 import os
-import secrets
 import sys
 import threading
 import time
@@ -86,8 +85,29 @@ class ApplicationMaster:
         self.host = host
         self.quiet = quiet
         self.token: Optional[str] = None
+        self.credentials: Optional[Dict[str, str]] = None
+        self.cred_provider = None
         if conf.get_bool(conf_mod.SECURITY_ENABLED, False):
-            self.token = secrets.token_hex(16)
+            from tony_tpu import security
+            self.cred_provider = security.provider_for(conf)
+            # Client-staged credentials win (acquire-at-submit); acquiring
+            # here covers AMs launched without a client (MiniPod/tests) and
+            # keeps every hop working from the same map.
+            self.credentials = security.read_credentials(self.job_dir)
+            if self.credentials is None:
+                self.credentials = self.cred_provider.acquire(
+                    conf, self.job_dir)
+                security.write_credentials(self.job_dir, self.credentials)
+            self.token = self.credentials.get("token")
+            if not self.token:
+                # The pre-SPI behavior ALWAYS authenticated the RPC
+                # surface when security was on; a provider that ships
+                # only external credentials must not silently downgrade.
+                raise ValueError(
+                    f"{conf_mod.SECURITY_ENABLED} is true but credential "
+                    f"provider {type(self.cred_provider).__name__} "
+                    f"supplied no 'token' entry to authenticate RPC")
+            # Back-compat surface older clients poll for.
             token_path = self.job_dir / AM_TOKEN_FILE
             token_path.write_text(self.token)
             token_path.chmod(0o600)
@@ -107,6 +127,33 @@ class ApplicationMaster:
     def _log(self, msg: str) -> None:
         if not self.quiet:
             print(f"[tony-am {self.app_id}] {msg}", file=sys.stderr, flush=True)
+
+    def _maybe_refresh_credentials(self) -> None:
+        """Periodic provider renewal (reference: delegation-token renewal).
+        Providers renew EXTERNAL credentials (ticket/cred files user code
+        reads); the in-flight RPC token is job-lifetime — see
+        tony_tpu.security. Interval 0 (default) disables the hook."""
+        if self.cred_provider is None or self.credentials is None:
+            return
+        from tony_tpu import security
+        interval_s = self.conf.get_int(
+            security.CREDENTIAL_REFRESH_INTERVAL_MS, 0) / 1e3
+        if interval_s <= 0:
+            return
+        now = time.monotonic()
+        if now < getattr(self, "_next_cred_refresh", 0.0):
+            return
+        self._next_cred_refresh = now + interval_s
+        try:
+            renewed = self.cred_provider.refresh(
+                self.conf, self.job_dir, dict(self.credentials))
+        except Exception as e:  # noqa: BLE001 — provider is plugin code
+            self._log(f"credential refresh failed (kept current): {e}")
+            return
+        if renewed is not None:
+            self.credentials = renewed
+            security.write_credentials(self.job_dir, renewed)
+            self._log("credentials refreshed")
 
     def request_stop(self, reason: str) -> None:
         """Graceful external stop (SIGTERM from the client's kill fallback).
@@ -134,13 +181,20 @@ class ApplicationMaster:
         src = self.job_dir / "src"
         if src.is_dir():
             env[constants.ENV_SRC_DIR] = str(src)
+        res = self.job_dir / "resources"
+        if res.is_dir():
+            env[constants.ENV_RESOURCES_DIR] = str(res)
         venv = self.conf.get(conf_mod.PYTHON_VENV)
         if venv and Path(venv).exists():
             # Resolve against the AM's cwd (= the client's, which wrote the
             # conf): executors run elsewhere and a relative path would
             # silently localize nothing.
             env[constants.ENV_VENV] = str(Path(venv).resolve())
-        if self.token:
+        if self.credentials is not None and self.cred_provider is not None:
+            # The provider decides what ships into containers (reference:
+            # tokens packed into every ContainerLaunchContext).
+            env.update(self.cred_provider.executor_env(self.credentials))
+        elif self.token:
             env[ENV_JOB_TOKEN] = self.token
         container = self.scheduler.launch(ContainerLaunch(
             job_type=job_type, index=index, env=env,
@@ -361,6 +415,7 @@ class ApplicationMaster:
 
                 self._handle_completed_containers(session)
                 self._check_heartbeats(session)
+                self._maybe_refresh_credentials()
 
                 if self._stop_reason is not None:
                     with session.lock:

@@ -184,12 +184,14 @@ def cmd_logs(args: argparse.Namespace) -> int:
               f"(wrong --workdir, or a remote-substrate job?)")
         return 1
     tail = max(0, args.tail)
+    printed_any = False
     for cdir in containers:
         for name in (constants.EXECUTOR_LOG_NAME,
                      constants.USER_STDOUT_NAME, constants.USER_STDERR_NAME):
             f = cdir / name
             if not f.is_file() or f.stat().st_size == 0:
                 continue
+            printed_any = True
             # Bounded memory either way: deque for --tail, streamed
             # line-by-line otherwise — container logs can be GBs.
             with open(f, errors="replace") as fh:
@@ -203,6 +205,10 @@ def cmd_logs(args: argparse.Namespace) -> int:
                     print(f"===== {cdir.name}/{name} =====")
                     for line in fh:
                         print(line.rstrip("\n"))
+    if not printed_any:
+        # Scripts need 'no logs yet' distinguishable from 'logs shown'.
+        print(f"no non-empty logs yet under {job_dir / 'containers'}")
+        return 1
     return 0
 
 

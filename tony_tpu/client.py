@@ -142,6 +142,39 @@ class TonyClient:
             else:
                 raise FileNotFoundError(f"--python_venv {venv} not found")
             self.conf.set(conf_mod.PYTHON_VENV, str(staged))
+        # tony.containers.resources: stage each entry under <job>/resources
+        # and rewrite the conf to the staged copies — executors resolve
+        # entries by basename against the (possibly remote) resources dir.
+        entries = self.conf.get_list(conf_mod.CONTAINERS_RESOURCES)
+        if entries:
+            res_dir = self.job_dir / "resources"
+            res_dir.mkdir(exist_ok=True)
+            names = [Path(e.partition("#")[0]).name for e in entries]
+            dupes = {n for n in names if names.count(n) > 1}
+            if dupes:
+                # Entries localize by basename into one flat dir; a
+                # collision would silently ship the first entry's bytes
+                # under the second entry's name.
+                raise ValueError(
+                    f"{conf_mod.CONTAINERS_RESOURCES}: duplicate "
+                    f"basenames {sorted(dupes)}")
+            staged_csv = []
+            for entry in entries:
+                path_s, marker, flag = entry.partition("#")
+                src = Path(path_s)
+                if not src.exists():
+                    raise FileNotFoundError(
+                        f"{conf_mod.CONTAINERS_RESOURCES} entry "
+                        f"{path_s!r} not found")
+                dest = res_dir / src.name
+                if not dest.exists():
+                    if src.is_dir():
+                        shutil.copytree(src, dest, symlinks=True)
+                    else:
+                        shutil.copy2(src, dest)
+                staged_csv.append(f"{dest}{marker}{flag}")
+            self.conf.set(conf_mod.CONTAINERS_RESOURCES,
+                          ",".join(staged_csv))
         self.conf.save(self.job_dir / "client-conf.json")
 
     def submit(self) -> None:
@@ -149,6 +182,14 @@ class TonyClient:
         ``createYarnApplication`` + ``submitApplication``)."""
         self.conf.validate()
         self.stage()
+        if self.conf.get_bool(conf_mod.SECURITY_ENABLED, False):
+            # Acquire-at-submit (reference: delegation tokens fetched by
+            # TonyClient before the AM context is built); the AM and its
+            # executors inherit these, they never re-acquire.
+            from tony_tpu import security
+            provider = security.provider_for(self.conf)
+            self._credentials = provider.acquire(self.conf, self.job_dir)
+            security.write_credentials(self.job_dir, self._credentials)
         self._launch_am()
         self._log(f"submitted application {self.app_id} "
                   f"(job dir {self.job_dir})")
@@ -181,6 +222,14 @@ class TonyClient:
         return None
 
     def _token(self) -> Optional[str]:
+        creds = getattr(self, "_credentials", None)
+        if creds is not None:
+            return creds.get("token")
+        from tony_tpu import security
+        creds = security.read_credentials(self.job_dir)
+        if creds is not None:
+            return creds.get("token")
+        # Pre-SPI jobs (an already-running AM from an older client).
         path = self.job_dir / AM_TOKEN_FILE
         return path.read_text().strip() if path.is_file() else None
 

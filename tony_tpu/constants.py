@@ -20,6 +20,7 @@ ENV_CONTAINER_ID = "TONY_CONTAINER_ID"      # container id for this executor
 ENV_LOG_DIR = "TONY_LOG_DIR"                # directory for executor+user logs
 ENV_SRC_DIR = "TONY_SRC_DIR"                # localized user source directory
 ENV_VENV = "TONY_VENV"                      # localized virtualenv (optional)
+ENV_RESOURCES_DIR = "TONY_RESOURCES_DIR"    # staged tony.containers.resources
 ENV_SUBMIT_TS = "TONY_SUBMIT_TS"            # client submit wall-clock (epoch s)
 
 # --- Environment contract: TaskExecutor -> user process ---------------------
@@ -65,6 +66,12 @@ ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_TPU_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"
 ENV_TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+# Host-subdivision contract (several tasks sharing one host's chips):
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+ENV_TPU_PROCESS_ADDRESSES = "TPU_PROCESS_ADDRESSES"
+ENV_TPU_PROCESS_PORT = "TPU_PROCESS_PORT"
+ENV_CLOUD_TPU_TASK_ID = "CLOUD_TPU_TASK_ID"
 
 # --- Well-known job types ---------------------------------------------------
 # (reference: open-ended; these are the conventional names used by the success

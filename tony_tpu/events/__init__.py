@@ -166,7 +166,10 @@ def read_events(path: str | Path) -> List[Dict[str, Any]]:
     with _parse_cache_lock:
         hit = _parse_cache.get(key)
         if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
-            return hit[2]
+            # Shallow copy: the list is the mutation surface callers
+            # actually touch (sort/filter/append); handing out the cached
+            # list itself would let one caller poison every later read.
+            return list(hit[2])
     records = _parse_file(path)
     with _parse_cache_lock:
         if len(_parse_cache) >= _CACHE_MAX_FILES:
@@ -174,7 +177,7 @@ def read_events(path: str | Path) -> List[Dict[str, Any]]:
             # order; good enough for a bound, no LRU bookkeeping needed.
             _parse_cache.pop(next(iter(_parse_cache)))
         _parse_cache[key] = (st.st_mtime_ns, st.st_size, records)
-    return records
+    return list(records)
 
 
 def job_metadata(path: str | Path) -> Dict[str, Any]:

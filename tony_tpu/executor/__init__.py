@@ -176,6 +176,7 @@ class TaskExecutor:
         self.host = e.get("TONY_EXECUTOR_HOST", "127.0.0.1")
         self.src_dir = e.get(constants.ENV_SRC_DIR) or None
         self.venv_path = e.get(constants.ENV_VENV) or None
+        self.resources_dir = e.get(constants.ENV_RESOURCES_DIR) or None
         self.log_dir = Path(e.get(constants.ENV_LOG_DIR, "."))
         self.token = e.get(ENV_JOB_TOKEN) or None
         self.client = RpcClient(self.am_address, token=self.token,
@@ -216,7 +217,13 @@ class TaskExecutor:
         if dest.exists():
             return dest
         if src.is_dir():
-            _link_tree(src, dest, symlinks=True)
+            # link vs copy: see conf.VENV_LOCALIZATION — links alias the
+            # staged inodes, so in-place writers must opt into "copy".
+            mode = (self.conf.get(conf_mod.VENV_LOCALIZATION) or "link")
+            if mode == "copy":
+                shutil.copytree(src, dest, symlinks=True)
+            else:
+                _link_tree(src, dest, symlinks=True)
         elif src.is_file():
             shutil.unpack_archive(str(src), str(dest))
             # Archives often wrap a single top-level dir: flatten to it.
@@ -227,6 +234,36 @@ class TaskExecutor:
         else:
             return None
         return dest
+
+    def localize_resources(self, dest: Path) -> None:
+        """Localize ``tony.containers.resources`` entries into the user
+        process cwd (reference: the YARN ``LocalResource`` map built by
+        ``Utils.uploadFileAndSetConfResources`` / ``LocalizableResource``).
+        Entries are resolved by basename against the staged resources dir
+        (``TONY_RESOURCES_DIR``) — the conf carries client-side staged
+        paths that need not exist on a remote worker. ``#archive`` entries
+        are unpacked in place of copied."""
+        entries = self.conf.get_list(conf_mod.CONTAINERS_RESOURCES)
+        for entry in entries:
+            path_s, _, flag = entry.partition("#")
+            name = Path(path_s).name
+            src = (Path(self.resources_dir) / name if self.resources_dir
+                   else Path(path_s))
+            if not src.exists():
+                raise RuntimeError(
+                    f"container resource {name!r} not found "
+                    f"(resources dir: {self.resources_dir})")
+            # Resources OVERWRITE same-named files in the cwd: they
+            # localize after the src copy, and a stale src-shipped file
+            # silently shadowing the declared resource is the worse bug.
+            target = dest / name
+            if flag == "archive":
+                shutil.unpack_archive(str(src), str(dest))
+            elif src.is_dir():
+                shutil.copytree(src, target, symlinks=True,
+                                dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, target)
 
     def _venv_env(self, venv: Optional[Path]) -> Dict[str, str]:
         """PATH/VIRTUAL_ENV entries so ``python`` in the user command
@@ -404,6 +441,7 @@ class TaskExecutor:
             if self.token:
                 env[ENV_JOB_TOKEN] = self.token
             cwd = str(src) if src else os.getcwd()
+            self.localize_resources(Path(cwd))
             pypath = [p for p in (cwd, env.get("PYTHONPATH")) if p]
             env["PYTHONPATH"] = os.pathsep.join(pypath)
             # 6. release reserved ports, launch the user process.

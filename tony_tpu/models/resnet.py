@@ -148,6 +148,14 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16      # compute dtype; params stay f32
     fused_bn: bool = False         # pallas BN+add+ReLU epilogues
     bn_interpret: bool = False     # interpret pallas kernels (CPU tests)
+    # MLPerf-standard space-to-depth stem: the 7x7/s2 conv on 224²x3
+    # becomes the mathematically equivalent 4x4/s1 conv on the s2d-packed
+    # 112²x12 input (kernel zero-padded 7→8 taps; see s2d_stem_kernel and
+    # tests/test_models.py::test_s2d_stem_equivalence). Input channels 3
+    # pay a physically padded layout on TPU; 12 is no better per element
+    # but touches the big tensor with 4x fewer rows — measured ~0.5 ms/step
+    # (exp/s2d_results.txt).
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -168,8 +176,20 @@ class ResNet(nn.Module):
                            param_dtype=jnp.float32)
             block_cls = Bottleneck
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="stem")(x)
+        if self.s2d_stem:
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
+                                                      4 * c)
+            # Output position i consumes original rows 2i-3..2i+3 = packed
+            # block rows i-2..i+1 → 4 taps, pad (2,1). Exact 7x7/s2
+            # equivalence: the zero tap (original offset -4) multiplies
+            # rows the 7x7 never read.
+            x = conv(self.width, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="stem")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                     name="stem")(x)
         if self.fused_bn:
             x = norm(name="stem_bn")(x)
         else:
@@ -197,6 +217,20 @@ def resnet18_thin(**kw) -> ResNet:
     kw.setdefault("width", 8)
     kw.setdefault("num_classes", 10)
     return ResNet(stage_sizes=(1, 1), **kw)
+
+
+def s2d_stem_kernel(k7: jax.Array) -> jax.Array:
+    """Transport a [7,7,Cin,Cout] stem kernel to the equivalent [4,4,4*Cin,
+    Cout] space-to-depth kernel: packed tap (p,q,dr,dc) reads original tap
+    (2p-1+dr, 2q-1+dc); the out-of-range taps (p=0,dr=0 → row -1) are the
+    zero padding that makes 7→8 taps exact. Proof of equivalence:
+    tests/test_models.py::test_s2d_stem_equivalence."""
+    cin, cout = k7.shape[2], k7.shape[3]
+    k8 = jnp.zeros((8, 8, cin, cout), k7.dtype).at[1:, 1:].set(k7)
+    # (a, b) = (2p-1+dr, 2q-1+dc) → k8 index (a+1, b+1) = (2p+dr, 2q+dc).
+    k4 = k8.reshape(4, 2, 4, 2, cin, cout)          # (p, dr, q, dc, ...)
+    k4 = k4.transpose(0, 2, 1, 3, 4, 5)             # (p, q, dr, dc, ...)
+    return k4.reshape(4, 4, 4 * cin, cout)
 
 
 def resnet50_flops(batch: int, image: int = 224) -> int:

@@ -146,17 +146,12 @@ class Attention(nn.Module):
                       seq_axis=1)
             k4 = rope(k.reshape(b, t, nkv, hd), positions, cfg.rope_theta,
                       seq_axis=1)
-            if nkv != nh:
-                # GQA still materializes repeated K/V here; a zero-copy
-                # variant would map query head h to kv block h//reps in
-                # the kernel's K/V index maps (and group the dkv grid by
-                # kv head) — deferred until a GQA config is on the bench.
-                reps = nh // nkv
-                k4 = jnp.repeat(k4, reps, axis=2)
-                v4 = jnp.repeat(v.reshape(b, t, nkv, hd), reps, axis=2)
-                v = v4.reshape(b, t, nh * hd)
+            # GQA is zero-copy through the packed kernels: K/V stay at
+            # [B, T, nkv·hd]; the kernel's index maps route query head h
+            # to kv lane-block h·nkv/nh (VERDICT r4 next-step #5 — no
+            # jnp.repeat, no phantom-head HBM).
             out = flash_attention_packed(
-                q4.reshape(b, t, nh * hd), k4.reshape(b, t, nh * hd), v,
+                q4.reshape(b, t, nh * hd), k4.reshape(b, t, nkv * hd), v,
                 nh, causal=True)
             return dense(cfg.dim, ("heads", "embed"), "wo")(out)
         # [B, T, H·D] → [B, H, T, D]
@@ -165,7 +160,13 @@ class Attention(nn.Module):
         v = v.reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if nkv != nh:  # GQA: repeat KV heads up to the query head count
+        if nkv != nh and cfg.attention == "ring" or (
+                nkv != nh and cfg.attention == "flash"
+                and cfg.mesh is not None
+                and cfg.mesh.shape.get("seq", 1) > 1):
+            # Ring attention rotates K/V around the seq axis and doesn't
+            # know GQA — repeat up to the query head count for it only.
+            # The flash kernels and reference_attention are GQA-native.
             reps = nh // nkv
             k = jnp.repeat(k, reps, axis=1)
             v = jnp.repeat(v, reps, axis=1)

@@ -39,9 +39,15 @@ _LSE_LANES = 8
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
                         scale: Optional[float] = None) -> jax.Array:
-    """Plain attention over [B, H, T, D], f32 softmax accumulation."""
+    """Plain attention over [B, H, T, D], f32 softmax accumulation.
+    K/V may carry fewer heads (GQA); they are repeated up to H here —
+    this is the semantic spec the zero-copy kernels are tested against."""
     d = q.shape[-1]
     scale = d ** -0.5 if scale is None else scale
+    if k.shape[1] != q.shape[1]:
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -169,18 +175,25 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                          scale: float, qi_axis: int = 1):
-    """dk/dv, streamed: grid ``(..., kj, qb)`` with the q-block axis
+                          scale: float, qi_axis: int = 1, nqb: int = 0):
+    """dk/dv, streamed: grid ``(..., kj, qx)`` with the q-side axis
     INNERMOST — q/do/o/lse arrive one block at a time while this k-block's
     dk/dv accumulate in VMEM scratch (dv += pᵀ·do; dk += dsᵀ·q·scale).
-    Causal k-blocks skip q-blocks strictly above the diagonal."""
+    Causal k-blocks skip q-blocks strictly above the diagonal.
+
+    GQA: one kv head serves ``reps`` query heads, so the innermost axis is
+    the FLATTENED (rep, q-block) index of size reps·nqb — the callers'
+    q-side index maps decode it — and dk/dv accumulate across the whole
+    sweep. ``nqb`` is the per-head q-block count (0 ⇒ no grouping: the
+    axis is plain q-blocks)."""
     bk, d = k_ref.shape
     bq = q_ref.shape[0]
     kj = pl.program_id(qi_axis)
-    qb = pl.program_id(qi_axis + 1)
-    nqb = pl.num_programs(qi_axis + 1)
+    qx = pl.program_id(qi_axis + 1)
+    nqx = pl.num_programs(qi_axis + 1)
+    qb = qx % nqb if nqb else qx
 
-    @pl.when(qb == 0)
+    @pl.when(qx == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -211,7 +224,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(qb == nqb - 1)
+    @pl.when(qx == nqx - 1)
     def _finalize():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
@@ -223,21 +236,35 @@ def _fwd_scratch(block_q, d):
             pltpu.VMEM((block_q, d), jnp.float32)]            # acc
 
 
+def _kv_head_of(h: int, hkv: int):
+    """Zero-copy GQA (VERDICT r4 next-step #5): map the flattened (batch,
+    query-head) grid index onto the (batch, kv-head) K/V array — query head
+    hq reads kv head hq·hkv//h. No repeated K/V ever materializes; with
+    h == hkv this is the identity."""
+    reps = h // hkv
+    if reps == 1:
+        return lambda g: g
+    return lambda g: (g // h) * hkv + (g % h) // reps
+
+
 def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, t, d = q.shape
-    tk = k.shape[2]
+    hkv, tk = k.shape[1], k.shape[2]
+    kv_of = _kv_head_of(h, hkv)
     grid = (b * h, pl.cdiv(t, block_q), pl.cdiv(tk, block_k))
     qr = q.reshape(b * h, t, d)
-    kr = k.reshape(b * h, tk, d)
-    vr = v.reshape(b * h, tk, d)
+    kr = k.reshape(b * hkv, tk, d)
+    vr = v.reshape(b * hkv, tk, d)
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda g, i, kb: (g, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda g, i, kb: (g, kb, 0)),
-            pl.BlockSpec((None, block_k, d), lambda g, i, kb: (g, kb, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda g, i, kb: (kv_of(g), kb, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda g, i, kb: (kv_of(g), kb, 0)),
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, d), lambda g, i, kb: (g, i, 0)),
@@ -261,14 +288,18 @@ def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret)
 def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_k,
                     interpret):
     b, h, t, d = q.shape
-    tk = k.shape[2]
+    hkv, tk = k.shape[1], k.shape[2]
+    reps = h // hkv
+    kv_of = _kv_head_of(h, hkv)
     bh = b * h
-    qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
+    qr = q.reshape(bh, t, d)
+    kr, vr = k.reshape(b * hkv, tk, d), v.reshape(b * hkv, tk, d)
     dor, outr = do.reshape(bh, t, d), o.reshape(bh, t, d)
     lser = lse                                    # [bh, t, _LSE_LANES]
     # dq grid: (bh, qi, kb) — k streamed innermost (q-side blocks pinned).
     q_pin = pl.BlockSpec((None, block_q, d), lambda g, i, kb: (g, i, 0))
-    k_str = pl.BlockSpec((None, block_k, d), lambda g, i, kb: (g, kb, 0))
+    k_str = pl.BlockSpec((None, block_k, d),
+                         lambda g, i, kb: (kv_of(g), kb, 0))
     lse_pin = pl.BlockSpec((None, block_q, _LSE_LANES),
                            lambda g, i, kb: (g, i, 0))
 
@@ -282,19 +313,28 @@ def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_
         interpret=interpret,
     )(qr, kr, vr, dor, outr, lser)
 
-    # dkv grid: (bh, kj, qb) — q-side streamed innermost (k-blocks pinned).
-    k_pin = pl.BlockSpec((None, block_k, d), lambda g, j, qb: (g, j, 0))
-    q_str = pl.BlockSpec((None, block_q, d), lambda g, j, qb: (g, qb, 0))
+    # dkv grid: (b·hkv, kj, qx) — qx is the flattened (rep, q-block) sweep
+    # (k-blocks pinned; dk/dv accumulate across ALL query heads this kv
+    # head serves).
+    nqb = pl.cdiv(t, block_q)
+
+    def q_head(g, qx):
+        return (g // hkv) * h + (g % hkv) * reps + qx // nqb
+
+    k_pin = pl.BlockSpec((None, block_k, d), lambda g, j, qx: (g, j, 0))
+    q_str = pl.BlockSpec((None, block_q, d),
+                         lambda g, j, qx: (q_head(g, qx), qx % nqb, 0))
     lse_str = pl.BlockSpec((None, block_q, _LSE_LANES),
-                           lambda g, j, qb: (g, qb, 0))
+                           lambda g, j, qx: (q_head(g, qx), qx % nqb, 0))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale),
-        grid=(bh, pl.cdiv(tk, block_k), pl.cdiv(t, block_q)),
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
+                          nqb=nqb),
+        grid=(b * hkv, pl.cdiv(tk, block_k), reps * nqb),
         in_specs=[q_str, k_pin, k_pin, q_str, q_str, lse_str],
         out_specs=(k_pin, k_pin),
-        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((b * hkv, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * hkv, tk, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
@@ -406,13 +446,25 @@ def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, d
 
 
 def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          scale: float, qi_axis: int = 1):
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+                          causal: bool, scale: float, qi_axis: int = 1):
     """dk/dv for one k-block: iterate q-blocks (from the diagonal down when
-    causal): dv += pᵀ·do; dk += dsᵀ·q·scale."""
+    causal): dv += pᵀ·do; dk += dsᵀ·q·scale.
+
+    GQA: the grid carries a ``rep`` axis INSIDE the k-block axis (size 1
+    without grouping); each rep step streams in one of the query heads this
+    kv head serves, and dk/dv accumulate in VMEM scratch across the sweep,
+    flushing on the last rep."""
     bk, d = k_ref.shape
     t = q_ref.shape[0]
     kj = pl.program_id(qi_axis)
+    rep = pl.program_id(qi_axis + 1)
+    nreps = pl.num_programs(qi_axis + 1)
+
+    @pl.when(rep == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
     # bf16 matmul operands / f32 accumulation + f32 softmax math — see the
     # forward kernel's dtype note.
     k_blk = k_ref[:]
@@ -446,19 +498,25 @@ def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32) * scale
         return dk_new, dv_new
 
-    zeros = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qb0, num_qb, body, (zeros, zeros))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    dk, dv = jax.lax.fori_loop(qb0, num_qb, body,
+                               (dk_scr[:], dv_scr[:]))
+    dk_scr[:] = dk
+    dv_scr[:] = dv
+
+    @pl.when(rep == nreps - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, t, d = q.shape
-    tk = k.shape[2]
+    hkv, tk = k.shape[1], k.shape[2]
+    kv_of = _kv_head_of(h, hkv)
     grid = (b * h, pl.cdiv(t, block_q))
     qr = q.reshape(b * h, t, d)
-    kr = k.reshape(b * h, tk, d)
-    vr = v.reshape(b * h, tk, d)
+    kr = k.reshape(b * hkv, tk, d)
+    vr = v.reshape(b * hkv, tk, d)
     kernel = functools.partial(_flash_kernel_resident, block_k=block_k,
                                causal=causal, scale=scale)
     out, lse = pl.pallas_call(
@@ -466,8 +524,8 @@ def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret)
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda bh, i: (kv_of(bh), 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda bh, i: (kv_of(bh), 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
@@ -489,17 +547,17 @@ def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret)
 def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_k,
                     interpret):
     b, h, t, d = q.shape
-    tk = k.shape[2]
+    hkv, tk = k.shape[1], k.shape[2]
+    reps = h // hkv
+    kv_of = _kv_head_of(h, hkv)
     bh = b * h
-    qr, kr, vr = (x.reshape(bh, -1, d) for x in (q, k, v))
+    qr = q.reshape(bh, t, d)
+    kr, vr = k.reshape(b * hkv, tk, d), v.reshape(b * hkv, tk, d)
     dor, outr = do.reshape(bh, t, d), o.reshape(bh, t, d)
     lser = lse                                    # [bh, t, _LSE_LANES]
     q_spec = pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0))
-    kv_full = pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0))
-    q_full = pl.BlockSpec((None, t, d), lambda g, i: (g, 0, 0))
+    kv_full = pl.BlockSpec((None, tk, d), lambda g, i: (kv_of(g), 0, 0))
     lse_blk = pl.BlockSpec((None, block_q, _LSE_LANES), lambda g, i: (g, i, 0))
-    lse_full = pl.BlockSpec((None, t, _LSE_LANES), lambda g, i: (g, 0, 0))
-    k_spec = pl.BlockSpec((None, block_k, d), lambda g, j: (g, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
@@ -511,14 +569,26 @@ def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_
         interpret=interpret,
     )(qr, kr, vr, dor, outr, lser)
 
+    # dkv grid: (b·hkv, kj, rep) — rep streams in, one at a time, the query
+    # heads this kv head serves; dk/dv accumulate in scratch across them.
+    def q_head(g, r):
+        return (g // hkv) * h + (g % hkv) * reps + r
+
+    q_full = pl.BlockSpec((None, t, d), lambda g, j, r: (q_head(g, r), 0, 0))
+    lse_full = pl.BlockSpec((None, t, _LSE_LANES),
+                            lambda g, j, r: (q_head(g, r), 0, 0))
+    k_spec = pl.BlockSpec((None, block_k, d), lambda g, j, r: (g, j, 0))
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel_resident, block_q=block_q,
                           causal=causal, scale=scale),
-        grid=(bh, pl.cdiv(tk, block_k)),
+        grid=(b * hkv, pl.cdiv(tk, block_k), reps),
         in_specs=[q_full, k_spec, k_spec, q_full, q_full, lse_full],
         out_specs=(k_spec, k_spec),
-        out_shape=(jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((b * hkv, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * hkv, tk, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr, dor, outr, lser)
     return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
@@ -556,7 +626,7 @@ def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
 
 def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
                           interpret):
-    if _resident_fits(k.shape[1], k.shape[2] // heads, k.dtype):
+    if _resident_fits(k.shape[1], q.shape[2] // heads, k.dtype):
         return _flash_forward_packed_resident(q, k, v, heads, causal, scale,
                                               block_q, block_k, interpret)
     return _flash_forward_packed_streamed(q, k, v, heads, causal, scale,
@@ -565,7 +635,7 @@ def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
 
 def _flash_backward_packed(q, k, v, do, o, lse, heads, causal, scale,
                            block_q, block_k, interpret):
-    if _resident_fits(k.shape[1], k.shape[2] // heads, k.dtype):
+    if _resident_fits(k.shape[1], q.shape[2] // heads, k.dtype):
         return _flash_backward_packed_resident(
             q, k, v, do, o, lse, heads, causal, scale, block_q, block_k,
             interpret)
@@ -578,10 +648,12 @@ def _flash_forward_packed_resident(q, k, v, heads, causal, scale, block_q, block
                           interpret):
     """Forward over the packed [B, T, H·D] layout: grid (b, h, i) with the
     head carried as a lane offset (block index h on the last dim) — no
-    [B, H, T, D] transpose ever materializes. Same kernel body."""
+    [B, H, T, D] transpose ever materializes. Same kernel body. GQA: K/V
+    are packed [B, T, Hkv·D]; query head h reads kv lane-block h·hkv//h."""
     b, t, hd = q.shape
     tk = k.shape[1]
     d = hd // heads
+    reps = hd // k.shape[2]
     grid = (b, heads, pl.cdiv(t, block_q))
     kernel = functools.partial(_flash_kernel_resident, block_k=block_k,
                                causal=causal, scale=scale, qi_axis=2)
@@ -590,8 +662,8 @@ def _flash_forward_packed_resident(q, k, v, heads, causal, scale, block_q, block
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bi, h, i: (bi, i, h)),
-            pl.BlockSpec((None, tk, d), lambda bi, h, i: (bi, 0, h)),
-            pl.BlockSpec((None, tk, d), lambda bi, h, i: (bi, 0, h)),
+            pl.BlockSpec((None, tk, d), lambda bi, h, i: (bi, 0, h // reps)),
+            pl.BlockSpec((None, tk, d), lambda bi, h, i: (bi, 0, h // reps)),
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, d), lambda bi, h, i: (bi, i, h)),
@@ -616,14 +688,13 @@ def _flash_backward_packed_resident(q, k, v, do, o, lse, heads, causal, scale,
     b, t, hd = q.shape
     tk = k.shape[1]
     d = hd // heads
+    hkv = k.shape[2] // d
+    reps = heads // hkv
     q_spec = pl.BlockSpec((None, block_q, d), lambda bi, h, i: (bi, i, h))
-    kv_full = pl.BlockSpec((None, tk, d), lambda bi, h, i: (bi, 0, h))
-    q_full = pl.BlockSpec((None, t, d), lambda bi, h, i: (bi, 0, h))
+    kv_full = pl.BlockSpec((None, tk, d),
+                           lambda bi, h, i: (bi, 0, h // reps))
     lse_blk = pl.BlockSpec((None, None, block_q, _LSE_LANES),
                            lambda bi, h, i: (bi, h, i, 0))
-    lse_full = pl.BlockSpec((None, None, t, _LSE_LANES),
-                            lambda bi, h, i: (bi, h, 0, 0))
-    k_spec = pl.BlockSpec((None, block_k, d), lambda bi, h, j: (bi, j, h))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
@@ -635,14 +706,25 @@ def _flash_backward_packed_resident(q, k, v, do, o, lse, heads, causal, scale,
         interpret=interpret,
     )(q, k, v, do, o, lse)
 
+    # dkv grid: (b, hkv, kj, rep) — rep streams the query heads this kv
+    # head serves; dk/dv accumulate in scratch (see the kernel docstring).
+    q_full = pl.BlockSpec((None, t, d),
+                          lambda bi, hk, j, r: (bi, 0, hk * reps + r))
+    lse_full = pl.BlockSpec((None, None, t, _LSE_LANES),
+                            lambda bi, hk, j, r: (bi, hk * reps + r, 0, 0))
+    k_spec = pl.BlockSpec((None, block_k, d),
+                          lambda bi, hk, j, r: (bi, j, hk))
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel_resident, block_q=block_q,
                           causal=causal, scale=scale, qi_axis=2),
-        grid=(b, heads, pl.cdiv(tk, block_k)),
+        grid=(b, hkv, pl.cdiv(tk, block_k), reps),
         in_specs=[q_full, k_spec, k_spec, q_full, q_full, lse_full],
         out_specs=(k_spec, k_spec),
-        out_shape=(jax.ShapeDtypeStruct((b, tk, hd), k.dtype),
-                   jax.ShapeDtypeStruct((b, tk, hd), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((b, tk, hkv * d), k.dtype),
+                   jax.ShapeDtypeStruct((b, tk, hkv * d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, o, lse)
     return dq, dk, dv
@@ -678,6 +760,7 @@ def _flash_forward_packed_streamed(q, k, v, heads, causal, scale, block_q, block
     b, t, hd = q.shape
     tk = k.shape[1]
     d = hd // heads
+    reps = hd // k.shape[2]
     grid = (b, heads, pl.cdiv(t, block_q), pl.cdiv(tk, block_k))
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
                                qi_axis=2)
@@ -688,9 +771,9 @@ def _flash_forward_packed_streamed(q, k, v, heads, causal, scale, block_q, block
             pl.BlockSpec((None, block_q, d),
                          lambda bi, h, i, kb: (bi, i, h)),
             pl.BlockSpec((None, block_k, d),
-                         lambda bi, h, i, kb: (bi, kb, h)),
+                         lambda bi, h, i, kb: (bi, kb, h // reps)),
             pl.BlockSpec((None, block_k, d),
-                         lambda bi, h, i, kb: (bi, kb, h)),
+                         lambda bi, h, i, kb: (bi, kb, h // reps)),
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, d),
@@ -717,11 +800,13 @@ def _flash_backward_packed_streamed(q, k, v, do, o, lse, heads, causal, scale,
     b, t, hd = q.shape
     tk = k.shape[1]
     d = hd // heads
+    hkv = k.shape[2] // d
+    reps = heads // hkv
     # dq grid: (b, h, qi, kb) — k streamed innermost.
     q_pin = pl.BlockSpec((None, block_q, d),
                          lambda bi, h, i, kb: (bi, i, h))
     k_str = pl.BlockSpec((None, block_k, d),
-                         lambda bi, h, i, kb: (bi, kb, h))
+                         lambda bi, h, i, kb: (bi, kb, h // reps))
     lse_pin = pl.BlockSpec((None, None, block_q, _LSE_LANES),
                            lambda bi, h, i, kb: (bi, h, i, 0))
 
@@ -736,22 +821,27 @@ def _flash_backward_packed_streamed(q, k, v, do, o, lse, heads, causal, scale,
         interpret=interpret,
     )(q, k, v, do, o, lse)
 
-    # dkv grid: (b, h, kj, qb) — q-side streamed innermost.
+    # dkv grid: (b, hkv, kj, qx) — qx flattens (rep, q-block), q-side
+    # streamed innermost; dk/dv accumulate across every query head this
+    # kv head serves.
+    nqb = pl.cdiv(t, block_q)
     k_pin = pl.BlockSpec((None, block_k, d),
-                         lambda bi, h, j, qb: (bi, j, h))
+                         lambda bi, hk, j, qx: (bi, j, hk))
     q_str = pl.BlockSpec((None, block_q, d),
-                         lambda bi, h, j, qb: (bi, qb, h))
+                         lambda bi, hk, j, qx:
+                         (bi, qx % nqb, hk * reps + qx // nqb))
     lse_str = pl.BlockSpec((None, None, block_q, _LSE_LANES),
-                           lambda bi, h, j, qb: (bi, h, qb, 0))
+                           lambda bi, hk, j, qx:
+                           (bi, hk * reps + qx // nqb, qx % nqb, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
-                          qi_axis=2),
-        grid=(b, heads, pl.cdiv(tk, block_k), pl.cdiv(t, block_q)),
+                          qi_axis=2, nqb=nqb),
+        grid=(b, hkv, pl.cdiv(tk, block_k), reps * nqb),
         in_specs=[q_str, k_pin, k_pin, q_str, q_str, lse_str],
         out_specs=(k_pin, k_pin),
-        out_shape=(jax.ShapeDtypeStruct((b, tk, hd), k.dtype),
-                   jax.ShapeDtypeStruct((b, tk, hd), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((b, tk, hkv * d), k.dtype),
+                   jax.ShapeDtypeStruct((b, tk, hkv * d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
@@ -850,10 +940,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     dominated (measured 14.5→9.7 ms per layer fwd+bwd going 128→256 at
     b32·h8·t512·d128 on v5e; 512 measured equal to 256 with more VMEM
     pressure).
+
+    GQA is zero-copy: K/V may carry ``heads // reps`` heads — the kernels'
+    index maps route query head h to kv head h·hkv/h, and the dk/dv grids
+    group by kv head, so no repeated K/V ever materializes in HBM.
     """
     d = q.shape[-1]
     scale = d ** -0.5 if scale is None else scale
     t, tk = q.shape[2], k.shape[2]
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads {q.shape[1]} not a multiple of kv heads "
+            f"{k.shape[1]}")
     if interpret is None:
         on_tpu = jax.default_backend() == "tpu"
         if not on_tpu:
@@ -892,19 +990,28 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
     transpose+copy the classic layout forces never materializes; the
     profiled win on the Llama bench is ~5% of step time. Requires
     ``head_dim`` to be a multiple of 128 (lane-tile alignment for the
-    per-head slices); otherwise use :func:`flash_attention`. K/V carry the
-    same ``heads`` count (GQA callers repeat first, as with the classic
-    layout)."""
+    per-head slices); otherwise use :func:`flash_attention`. GQA is
+    zero-copy here too: K/V may be packed ``[B, T, Hkv·D]`` with
+    ``heads % Hkv == 0`` — query head h reads kv lane-block h·Hkv/heads."""
     b, t, hd = q.shape
     tk = k.shape[1]
     if hd % heads:
         raise ValueError(
             f"packed dim {hd} is not divisible by heads={heads}")
     d = hd // heads
+    if k.shape[2] % d or heads % (k.shape[2] // d):
+        raise ValueError(
+            f"packed kv dim {k.shape[2]} is not a head-multiple of "
+            f"head_dim {d} dividing heads={heads}")
+    if k.shape != v.shape:
+        # reps is derived from k; a mixed narrow-k/wide-v call (the
+        # pre-GQA convention) would silently read wrong v lane blocks.
+        raise ValueError(f"k {k.shape} and v {v.shape} must match")
     scale = d ** -0.5 if scale is None else scale
 
     def unpacked_fallback():
-        to4 = lambda x: x.reshape(b, -1, heads, d).transpose(0, 2, 1, 3)
+        def to4(x):
+            return x.reshape(b, -1, x.shape[2] // d, d).transpose(0, 2, 1, 3)
         out = flash_attention(to4(q), to4(k), to4(v), causal=causal,
                               scale=scale, block_q=block_q, block_k=block_k,
                               interpret=interpret)
@@ -956,12 +1063,13 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         dp_size *= mesh.shape[a]
     tp = model_axis if model_axis in mesh.axis_names else None
     tp_size = mesh.shape[tp] if tp else 1
-    if b % dp_size or h % tp_size:
-        # shard_map needs exact divisibility; rather than hard-fail a
+    if b % dp_size or h % tp_size or k.shape[1] % tp_size:
+        # shard_map needs exact divisibility (GQA: kv heads shard over the
+        # same tp axis, so they must divide too); rather than hard-fail a
         # config the plain GSPMD path would run (slowly), fall back.
         _warn_fallback(
-            f"batch {b} % dp {dp_size} or heads {h} % tp {tp_size} != 0; "
-            f"flash kernel will run unmapped under GSPMD")
+            f"batch {b} % dp {dp_size} or heads {h}/kv {k.shape[1]} % tp "
+            f"{tp_size} != 0; flash kernel will run unmapped under GSPMD")
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)

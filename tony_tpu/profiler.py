@@ -90,6 +90,7 @@ def _wait_reachable(addr: str, timeout_s: float) -> bool:
     import time
 
     host, _, port = addr.rpartition(":")
+    host = host.strip("[]")   # "[::1]:9431" → host "::1"
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         try:
@@ -181,7 +182,10 @@ def list_traces(history_dir: str | Path,
         entry = {"file": str(p.relative_to(root)), "bytes": p.stat().st_size}
         owner = "session"
         for task_id, addr in manifest.items():
-            if addr.replace(":", "_") in p.name:
+            # Brackets never appear in xplane filenames — "[::1]:9431"
+            # must match as "__1_9431", not "[__1]_9431".
+            if addr.replace("[", "").replace("]", "") \
+                    .replace(":", "_") in p.name:
                 owner = task_id.replace(":", "_")
                 break
         by_task.setdefault(owner, []).append(entry)

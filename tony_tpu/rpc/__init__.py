@@ -164,6 +164,15 @@ class RpcClient:
         loops like the executor's gang barrier must not block a full
         default window past their own deadline), raises :class:`RpcError`
         on application errors."""
+        if any(k.startswith("_") for k in params):
+            # "_"-prefixed kwargs are reserved for client-side options
+            # (today: _timeout). Without this guard an RPC param named
+            # _timeout would silently become the deadline override — and,
+            # conversely, this line is where a future _retries/_trace
+            # option is protected from leaking onto the wire.
+            raise TypeError(
+                f"reserved client-option name(s) in RPC params: "
+                f"{sorted(k for k in params if k.startswith('_'))}")
         req = {"method": method, "params": params}
         if self.token:
             req["token"] = self.token

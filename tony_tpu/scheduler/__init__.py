@@ -344,6 +344,8 @@ class TpuVmScheduler(ContainerScheduler):
                 f"{wd}/conf/{constants.TONY_JOB_JSON}")
         if constants.ENV_SRC_DIR in env:
             env[constants.ENV_SRC_DIR] = f"{wd}/src"
+        if constants.ENV_RESOURCES_DIR in env:
+            env[constants.ENV_RESOURCES_DIR] = f"{wd}/resources"
         venv = env.get(constants.ENV_VENV)
         if venv:
             # Archives stage as the file itself; dirs stage as contents.
@@ -430,6 +432,9 @@ class TpuVmScheduler(ContainerScheduler):
                             items=Path(venv).name)
             elif venv and Path(venv).is_dir():
                 self._stage(venv, host, "venv-stage")
+            res_dir = launch.env.get(constants.ENV_RESOURCES_DIR)
+            if res_dir and Path(res_dir).is_dir():
+                self._stage(res_dir, host, "resources")
             self._staged_hosts.add(host)
 
     def launch(self, launch: ContainerLaunch) -> Container:

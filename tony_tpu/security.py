@@ -1,0 +1,117 @@
+"""Credential-provider SPI (reference: the Kerberos login + HDFS/RM
+delegation-token plumbing scattered through ``TonyClient`` /
+``TonyApplicationMaster`` / ``Utils`` — SURVEY.md §2.1 "Security", ≈300 LoC).
+
+The reference's *shape*, kept; its Hadoop substance, replaced by a
+pluggable hook:
+
+* **acquire at submit** — the client calls :meth:`CredentialProvider.acquire`
+  and writes the credential map to ``<job>/credentials.json`` (mode 0600),
+  the moral equivalent of the delegation tokens packed into the AM launch
+  context;
+* **ship** — the AM loads that file (or acquires itself when launched
+  without a client, e.g. MiniPod), authenticates its RPC surface with the
+  ``token`` entry, and injects :meth:`CredentialProvider.executor_env` into
+  every container (the ``HADOOP_TOKEN_FILE_LOCATION`` analogue);
+* **refresh** — for long jobs the AM periodically calls
+  :meth:`CredentialProvider.refresh` so providers can renew *external*
+  credentials (files, tickets). The wire-auth ``token`` itself is
+  job-lifetime: executors bake it into their env at launch, exactly like
+  the reference's static ClientToAM token.
+
+The default provider is the round-3 job token, unchanged on the wire; a
+deployment plugs its own with
+``tony.security.credential-provider = my_pkg.my_mod:MyProvider``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import secrets
+from pathlib import Path
+from typing import Dict, Optional
+
+CREDENTIALS_FILE = "credentials.json"
+
+# Conf keys (registered here, not conf/__init__.py, to keep the security
+# surface in one file; conf docs point here).
+CREDENTIAL_PROVIDER = "tony.security.credential-provider"
+CREDENTIAL_REFRESH_INTERVAL_MS = "tony.security.credential-refresh-interval-ms"
+
+
+class CredentialProvider:
+    """SPI base. Subclass and point ``tony.security.credential-provider``
+    at ``module:Class``. All methods run with the job conf and job dir —
+    providers needing state should keep it under the job dir so it ships
+    with the job and dies with it."""
+
+    name = "base"
+
+    def acquire(self, conf, job_dir: Path) -> Dict[str, str]:
+        """Called ONCE at submit, client side (AM side only when no client
+        staged credentials — dev harnesses). Returns the credential map;
+        the ``token`` entry, if present, becomes the RPC auth token."""
+        raise NotImplementedError
+
+    def refresh(self, conf, job_dir: Path,
+                current: Dict[str, str]) -> Optional[Dict[str, str]]:
+        """Periodic AM-side renewal hook; return a replacement map to
+        rewrite ``credentials.json`` (and future container launches), or
+        None to keep the current one. The in-flight RPC token is NOT
+        re-keyed: launched executors hold the env they were born with."""
+        return None
+
+    def executor_env(self, creds: Dict[str, str]) -> Dict[str, str]:
+        """Env injected into every container for this credential map."""
+        from tony_tpu.rpc import ENV_JOB_TOKEN
+
+        return {ENV_JOB_TOKEN: creds["token"]} if "token" in creds else {}
+
+
+class TokenCredentialProvider(CredentialProvider):
+    """Default: a per-job random shared secret (the reference's
+    ClientToAM-token analogue, exactly round 3's wire behavior)."""
+
+    name = "token"
+
+    def acquire(self, conf, job_dir: Path) -> Dict[str, str]:
+        return {"token": secrets.token_hex(16)}
+
+
+def provider_for(conf) -> CredentialProvider:
+    """Resolve ``tony.security.credential-provider``: the built-in name
+    ``token`` (default) or a ``module:Class`` dotted path."""
+    spec = conf.get(CREDENTIAL_PROVIDER, "token")
+    if spec == "token":
+        return TokenCredentialProvider()
+    mod_name, sep, cls_name = spec.partition(":")
+    if not sep:
+        raise ValueError(
+            f"{CREDENTIAL_PROVIDER}={spec!r}: expected 'token' or "
+            f"'module:Class'")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    provider = cls()
+    if not isinstance(provider, CredentialProvider):
+        raise TypeError(f"{spec} is not a CredentialProvider")
+    return provider
+
+
+def write_credentials(job_dir: Path, creds: Dict[str, str]) -> Path:
+    import os
+
+    path = Path(job_dir) / CREDENTIALS_FILE
+    # 0600 from birth — a write-then-chmod leaves a window where other
+    # local users can read the token on a shared submit host.
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(json.dumps(creds))
+    os.chmod(path, 0o600)   # refresh rewrites reuse the existing inode
+    return path
+
+
+def read_credentials(job_dir: Path) -> Optional[Dict[str, str]]:
+    path = Path(job_dir) / CREDENTIALS_FILE
+    if not path.is_file():
+        return None
+    return {str(k): str(v) for k, v in json.loads(path.read_text()).items()}
