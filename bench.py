@@ -16,62 +16,15 @@ import functools
 import json
 import os
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
 
-# Peak bf16 matmul FLOP/s per chip by generation (public spec sheets).
-PEAK_BF16 = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-
-def best_window_time(window, carry, params_of, default_windows=4):
-    """Shared measurement protocol for both benches: run
-    ``window(carry) -> (carry, loss)`` twice as warmup (compile + steady
-    state), then best-of-N timed runs. Each run is fenced via host readback
-    of the loss AND a param leaf — through the remote PJRT relay,
-    ``block_until_ready`` returns before execution finishes, so a
-    device→host transfer is the only reliable fence, and the last optimizer
-    update is not a dependency of its own step's loss. Best window wins:
-    the relay path has heavy run-to-run jitter (67–266 ms spread measured
-    on one step) and the fastest window best estimates device throughput.
-
-    Returns ``(best_seconds, carry, loss)``.
-    """
-    carry, loss = window(carry)
-    float(loss)
-    carry, loss = window(carry)
-    float(loss)
-    best = float("inf")
-    for _ in range(int(os.environ.get("BENCH_WINDOWS",
-                                      str(default_windows)))):
-        t0 = time.perf_counter()
-        carry, loss = window(carry)
-        float(loss)
-        float(jax.tree_util.tree_leaves(params_of(carry))[0].ravel()[0])
-        best = min(best, time.perf_counter() - t0)
-    return best, carry, loss
-
-
-def chip_generation() -> str:
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN") or os.environ.get(
-        "TPU_ACCELERATOR_TYPE", "v5e")
-    return gen.split("-")[0].lower()
+from tony_tpu.benchmark import (PEAK_BF16, best_window_time,
+                                chip_generation, peak_flops,
+                                run_resnet_bench)
 
 
 def main() -> int:
-    import optax
-    import flax.linen as nn
-
-    from tony_tpu.models import get_model
-    from tony_tpu.models.resnet import resnet50_flops
-    from tony_tpu import train as tr
-
     backend = jax.default_backend()
     on_tpu = backend not in ("cpu",)
     # Batch 384: peak of the r3 sweep on v5e (128→0.247, 256→0.266,
@@ -95,69 +48,128 @@ def main() -> int:
     # 4x4/s1 stem on the 112²x12 packing. Measured on v5e at batch 384:
     # see exp/s2d_results.txt and README round-5 notes.
     s2d = os.environ.get("BENCH_S2D", "1") == "1"
-    model = get_model("resnet50", fused_bn=fused_bn, s2d_stem=s2d)
-    kx, ky, kinit = jax.random.split(jax.random.PRNGKey(0), 3)
-    x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
-    y = jax.random.randint(ky, (batch,), 0, 1000)
-    variables = jax.jit(lambda: model.init(kinit, x, train=False))()
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    tx = optax.sgd(0.1, momentum=0.9)
-    opt_state = jax.jit(tx.init)(params)
-
-    def step(carry, _):
-        params, opt_state, batch_stats = carry
-
-        def loss_fn(p):
-            logits, updates = model.apply(
-                {"params": p, "batch_stats": batch_stats}, x, train=True,
-                mutable=["batch_stats"])
-            return tr.cross_entropy_loss(logits, y), updates["batch_stats"]
-
-        (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state, new_stats), loss
-
-    # The whole timed window is ONE jitted lax.scan over `steps` train
-    # steps: through the remote PJRT relay each dispatch costs ~5 ms, so a
-    # per-step host loop would tax every step; one dispatch per window
-    # amortizes it to noise.
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def window(carry):
-        carry, losses = jax.lax.scan(step, carry, None, length=steps)
-        return carry, losses[-1]
-
-    elapsed, (params, opt_state, batch_stats), loss = best_window_time(
-        window, (params, opt_state, batch_stats), params_of=lambda c: c[0])
-
-    images_per_sec = batch * steps / elapsed
-    # fwd ≈ 8.2 GFLOP/image @224² (MACs×2); training ≈ 3× forward.
-    train_flops_per_step = 3 * resnet50_flops(batch, image)
-    gen = chip_generation()
-    peak = PEAK_BF16.get(gen, PEAK_BF16["v5e"]) if on_tpu else 1e12
-    mfu = train_flops_per_step * steps / elapsed / peak
-
-    result = {
-        "metric": "resnet50_mfu",
-        "value": round(mfu, 4),
-        "unit": "fraction_of_bf16_peak",
-        "vs_baseline": round(mfu / 0.55, 4),
-        "images_per_sec_per_chip": round(images_per_sec, 1),
-        "batch": batch,
-        "image": image,
-        "backend": backend,
-        "chip": gen,
-        "fused_bn": fused_bn,
-        "loss": float(loss),
-    }
+    # The step construction, scanned-window protocol, fencing, and MFU
+    # accounting live in tony_tpu.benchmark so the tony-submitted bench
+    # job (examples/resnet_bench_job) measures the IDENTICAL thing.
+    result = run_resnet_bench(batch, image, steps, s2d=s2d,
+                              fused_bn=fused_bn, on_tpu=on_tpu)
+    peak = peak_flops(on_tpu)
+    # One cumulative JSON line per completed leg (the driver/judge read the
+    # LAST line): the 7B leg alone compiles for minutes, and a harness
+    # timeout mid-leg must not cost the already-measured numbers.
+    print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))
         except Exception as e:  # secondary metric must not sink the bench
             result["llm_error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(result))
+        print(json.dumps(result), flush=True)
+    if on_tpu and os.environ.get("BENCH_LLM_GQA", "1") != "0":
+        # Zero-copy GQA leg (r5): same proxy shapes, kv_heads = heads/4.
+        # MFU accounting counts the SMALLER kv projections, so the delta
+        # is genuine kernel efficiency, not bookkeeping (r5 measured:
+        # 0.585 MHA → 0.612 GQA, +13% tokens/sec).
+        prior = os.environ.get("BENCH_LLM_KV_HEADS")
+        try:
+            os.environ["BENCH_LLM_KV_HEADS"] = str(
+                max(1, int(os.environ.get("BENCH_LLM_HEADS", "8")) // 4))
+            gqa = bench_llm(peak)
+            result["llm_gqa_mfu"] = gqa["llm_mfu"]
+            result["llm_gqa_tokens_per_sec"] = gqa["tokens_per_sec_per_chip"]
+        except Exception as e:
+            result["llm_gqa_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            if prior is None:
+                os.environ.pop("BENCH_LLM_KV_HEADS", None)
+            else:
+                os.environ["BENCH_LLM_KV_HEADS"] = prior
+        print(json.dumps(result), flush=True)
+    if on_tpu and os.environ.get("BENCH_LLM_7B", "1") != "0":
+        try:
+            result.update(bench_llm_7b(peak))
+        except Exception as e:
+            result["llm_7b_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     return 0
+
+
+def bench_llm_7b(peak: float) -> dict:
+    """True Llama-2-7B LAYER shapes (SURVEY.md §6 config ⑤: dim 4096,
+    32 heads, ffn 11008, vocab 32000), measured honestly under the 1-chip
+    16 GB HBM constraint: f32 adamw state for 32 such layers needs ~100 GB
+    (that is what fsdp shards on a pod), so the chip fits 2–3 layers and a
+    small-L proxy over-weights the lm head ~12× vs the real model (24.5%
+    of FLOPs at L=2 vs 2% at L=32).
+
+    Protocol: run L=2 and L=3 at identical batch/seq/remat, difference the
+    step times → the MARGINAL per-layer time (head/embed/overhead cancel),
+    then report (a) the marginal per-layer MFU — the efficiency a 32-layer
+    stack's bulk runs at — and (b) the 32-layer extrapolation
+    t(32) = fixed + 32·marginal with full-model FLOPs. Round-5 measured:
+    82 ms marginal layer, 61% marginal MFU, vs 51.5% raw at L=3.
+    """
+    import functools as _f
+
+    import optax
+
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+
+    batch = int(os.environ.get("BENCH_LLM_7B_BATCH", "16"))
+    seq = int(os.environ.get("BENCH_LLM_7B_SEQ", "512"))
+    dim, heads, ffn, vocab = 4096, 32, 11008, 32000
+    steps = int(os.environ.get("BENCH_LLM_7B_STEPS", "10"))
+    times = {}
+    for layers in (2, 3):
+        model = get_model(
+            "llama2-7b", dim=dim, n_layers=layers, n_heads=heads,
+            n_kv_heads=heads, ffn_hidden=ffn, vocab=vocab, max_seq=seq,
+            attention="flash", scan_layers=False, remat=True,
+            xent_chunk=1024)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(0), (batch, seq), 0, vocab)
+        state = tr.create_train_state(
+            model, optax.adamw(1e-4), tokens, jax.random.PRNGKey(1))
+        step = tr.make_train_step(
+            loss_of=lambda out, b: out,
+            apply_kwargs_of=lambda b: {"targets": b["x"]})
+
+        def scan_step(state, _):
+            state, metrics = step(state, {"x": tokens})
+            return state, metrics["loss"]
+
+        @_f.partial(jax.jit, donate_argnums=(0,))
+        def window(state):
+            state, losses = jax.lax.scan(scan_step, state, None,
+                                         length=steps)
+            return state, losses[-1]
+
+        best, state, _ = best_window_time(window, state,
+                                          params_of=lambda s: s.params,
+                                          default_windows=2)
+        times[layers] = best / steps
+        del state
+
+    marginal_s = times[3] - times[2]
+    fixed_s = times[2] - 2 * marginal_s
+    tokens_per_step = batch * seq
+    # Per-layer matmul FLOPs (fwd+bwd = 6·params + attention seq term).
+    layer_flops = (6 * (dim * dim * 4 + 3 * dim * ffn)
+                   + 12 * dim * seq) * tokens_per_step
+    marginal_mfu = layer_flops / marginal_s / peak
+    full_layers = 32
+    t32 = fixed_s + full_layers * marginal_s
+    flops32 = (full_layers * layer_flops
+               + 6 * vocab * dim * tokens_per_step)
+    return {
+        "llm_7b_marginal_layer_mfu": round(marginal_mfu, 4),
+        "llm_7b_extrapolated_32l_mfu": round(flops32 / t32 / peak, 4),
+        "llm_7b_raw_3l_mfu_note":
+            "see README r5: small-L proxies over-weight the lm head",
+        "llm_7b_batch": batch,
+        "llm_7b_seq": seq,
+        "llm_7b_marginal_layer_ms": round(marginal_s * 1e3, 2),
+    }
 
 
 def bench_llm(peak: float) -> dict:
@@ -187,6 +199,7 @@ def bench_llm(peak: float) -> dict:
     layers = int(os.environ.get("BENCH_LLM_LAYERS", "12"))
     vocab = int(os.environ.get("BENCH_LLM_VOCAB", "32768"))
     remat = os.environ.get("BENCH_LLM_REMAT", "0") == "1"
+    remat_policy = os.environ.get("BENCH_LLM_REMAT_POLICY") or None
     scan_layers = os.environ.get("BENCH_LLM_SCAN", "0") == "1"
     # Row-chunked fused head+CE (train.chunked_next_token_xent): the
     # [B,T,V] logits never materialize, lifting the f32-logits HBM cap
@@ -196,7 +209,8 @@ def bench_llm(peak: float) -> dict:
         "llama2-7b", dim=dim, n_layers=layers, n_heads=heads,
         n_kv_heads=kv_heads, ffn_hidden=ffn, vocab=vocab, max_seq=seq,
         attention=os.environ.get("BENCH_LLM_ATTN", "flash"),
-        scan_layers=scan_layers, remat=remat, xent_chunk=xent_chunk)
+        scan_layers=scan_layers, remat=remat, remat_policy=remat_policy,
+        xent_chunk=xent_chunk)
     cfg = model.cfg
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab)

@@ -1,0 +1,21 @@
+"""ResNet-50 DP bench INSIDE a tony job (BASELINE.md: the north star is
+measured "via tony-submit", not via a bare script — VERDICT r4 next-step
+#2). Runs the IDENTICAL step/protocol as bench.py via tony_tpu.benchmark,
+prints the one-line JSON, and writes it to ./bench_result.json for the
+client/test to collect."""
+import json
+import os
+import sys
+
+from tony_tpu.benchmark import run_resnet_bench
+
+batch = int(os.environ.get("BENCH_BATCH", "384"))
+image = int(os.environ.get("BENCH_IMAGE", "224"))
+steps = int(os.environ.get("BENCH_STEPS", "20"))
+result = run_resnet_bench(batch, image, steps)
+result["task"] = "{}:{}".format(os.environ.get("TONY_JOB_NAME", "?"),
+                                os.environ.get("TONY_TASK_INDEX", "?"))
+print(json.dumps(result))
+with open("bench_result.json", "w") as f:
+    json.dump(result, f)
+sys.exit(0)

@@ -570,3 +570,33 @@ def test_containers_resources_duplicate_basename_rejected(tmp_path):
         src_dir=WORKLOADS, workdir=tmp_path / "jobs", stream=io.StringIO())
     with pytest.raises(ValueError, match="duplicate"):
         client.stage()
+
+
+def test_resnet_bench_job_via_submit(tmp_path):
+    """The north-star measurement path (BASELINE.md: "via tony-submit"):
+    examples/resnet_bench_job runs the bench.py step INSIDE a submitted
+    job and emits the same JSON schema; the jhist carries the
+    submit->all-running latency. CPU-shape here; the real-chip numbers are
+    recorded in the README."""
+    example = Path(__file__).parent.parent / "examples" / "resnet_bench_job"
+    client = TonyClient(
+        TonyConfig(base_props(**{
+            "tony.application.framework": "jax",
+            "tony.application.executes": "python train.py",
+            "tony.worker.env":
+                "BENCH_BATCH=4,BENCH_IMAGE=32,BENCH_STEPS=2,BENCH_WINDOWS=1",
+        })),
+        src_dir=example, workdir=tmp_path / "jobs", stream=io.StringIO())
+    assert client.run(timeout=240) == 0
+    [result] = Path(client.job_dir).glob("containers/*/src/bench_result.json")
+    data = json.loads(result.read_text())
+    assert data["metric"] == "resnet50_mfu"
+    assert data["images_per_sec_per_chip"] > 0
+    assert data["task"] == "worker:0"
+    # The latency metric exists in the event log (ALL_TASKS_RUNNING).
+    from tony_tpu.events import read_events
+    [jhist] = Path(client.job_dir).glob("history/finished/**/*.jhist")
+    evs = read_events(jhist)
+    all_running = [e for e in evs if e.get("type") == "ALL_TASKS_RUNNING"]
+    assert all_running
+    assert all_running[0]["payload"]["submit_to_running_s"] > 0

@@ -235,3 +235,22 @@ def test_s2d_resnet_trains():
     out, updates = model.apply(variables, x, train=True,
                                mutable=["batch_stats"])
     assert out.shape == (2, 10)
+
+
+def test_remat_policy_variants():
+    """remat_policy selects a jax.checkpoint policy (dots = save matmul
+    outputs); all variants train and an unknown name fails loudly."""
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 256)
+    for policy in (None, "dots", "dots_no_batch"):
+        model = get_model("llama-tiny", remat=True, remat_policy=policy,
+                          scan_layers=False)
+        state = train.create_train_state(
+            model, optax.adam(1e-3), tokens, jax.random.PRNGKey(1))
+        step = train.make_train_step(
+            loss_of=lambda lg, b: train.next_token_loss(lg, b["x"]))
+        _, m = step(state, {"x": tokens})
+        assert jnp.isfinite(m["loss"]), policy
+    import pytest as _pytest
+    bad = get_model("llama-tiny", remat=True, remat_policy="nope")
+    with _pytest.raises(ValueError, match="remat_policy"):
+        bad.init(jax.random.PRNGKey(0), tokens)

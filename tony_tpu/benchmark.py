@@ -1,0 +1,143 @@
+"""Shared ResNet-50 benchmark core: the exact step, measurement protocol,
+and MFU accounting used by ``bench.py`` — importable so the same number can
+be produced INSIDE a ``tony submit`` job (BASELINE.md measures the north
+star "via tony-submit", not via a bare script; see
+``examples/resnet_bench_job``).
+
+Protocol (ROOFLINE.md): the timed window is ONE jitted ``lax.scan`` over
+``steps`` train steps (per-step dispatch over the remote PJRT relay costs
+~5 ms); each window is fenced by device→host readback of the loss AND a
+param leaf (``block_until_ready`` returns early through the relay); best
+window of N wins (relay jitter is heavy-tailed).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Peak bf16 matmul FLOP/s per chip by generation (public spec sheets).
+PEAK_BF16 = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def chip_generation() -> str:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN") or os.environ.get(
+        "TPU_ACCELERATOR_TYPE", "v5e")
+    return gen.split("-")[0].lower()
+
+
+def best_window_time(window, carry, params_of, default_windows=4):
+    """Run ``window(carry) -> (carry, loss)`` twice as warmup (compile +
+    steady state), then best-of-N timed runs, each device→host fenced.
+    Returns ``(best_seconds, carry, loss)``."""
+    carry, loss = window(carry)
+    float(loss)
+    carry, loss = window(carry)
+    float(loss)
+    best = float("inf")
+    for _ in range(int(os.environ.get("BENCH_WINDOWS",
+                                      str(default_windows)))):
+        t0 = time.perf_counter()
+        carry, loss = window(carry)
+        float(loss)
+        float(jax.tree_util.tree_leaves(params_of(carry))[0].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best, carry, loss
+
+
+def resnet_window(batch: int, image: int, steps: int, *,
+                  s2d: bool = True, fused_bn: bool = False):
+    """(window, carry): the full ResNet-50 train step (fwd + bwd + SGD +
+    BatchNorm stats) on synthetic ImageNet-shaped bf16 data, scanned
+    ``steps`` times per dispatch."""
+    import optax
+
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+
+    model = get_model("resnet50", fused_bn=fused_bn, s2d_stem=s2d)
+    kx, ky, kinit = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (batch, image, image, 3), jnp.bfloat16)
+    y = jax.random.randint(ky, (batch,), 0, 1000)
+    variables = jax.jit(lambda: model.init(kinit, x, train=False))()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+
+    def step(carry, _):
+        params, opt_state, batch_stats = carry
+
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return tr.cross_entropy_loss(logits, y), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, new_stats), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def window(carry):
+        carry, losses = jax.lax.scan(step, carry, None, length=steps)
+        return carry, losses[-1]
+
+    return window, (params, opt_state, batch_stats)
+
+
+def peak_flops(on_tpu: bool | None = None) -> float:
+    """THE peak-FLOPs rule for MFU accounting (single definition — every
+    bench leg divides by this): the chip generation's bf16 peak on TPU, a
+    1e12 sentinel off-TPU so CPU smoke runs produce obviously-not-TPU
+    numbers. ``on_tpu=None`` derives from the live backend."""
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        return 1e12
+    return PEAK_BF16.get(chip_generation(), PEAK_BF16["v5e"])
+
+
+def run_resnet_bench(batch: int, image: int, steps: int, *,
+                     s2d: bool = True, fused_bn: bool = False,
+                     on_tpu: bool | None = None) -> dict:
+    """Measure and return the headline dict (metric/value/vs_baseline…).
+    ``on_tpu`` defaults to backend auto-detection so every caller (bench.py
+    AND the tony-submitted job) accounts MFU identically."""
+    from tony_tpu.models.resnet import resnet50_flops
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    window, carry = resnet_window(batch, image, steps, s2d=s2d,
+                                  fused_bn=fused_bn)
+    elapsed, carry, loss = best_window_time(window, carry,
+                                            params_of=lambda c: c[0])
+    images_per_sec = batch * steps / elapsed
+    train_flops_per_step = 3 * resnet50_flops(batch, image)
+    gen = chip_generation()
+    peak = peak_flops(on_tpu)
+    mfu = train_flops_per_step * steps / elapsed / peak
+    return {
+        "metric": "resnet50_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_bf16_peak",
+        "vs_baseline": round(mfu / 0.55, 4),
+        "images_per_sec_per_chip": round(images_per_sec, 1),
+        "batch": batch,
+        "image": image,
+        "backend": jax.default_backend(),
+        "chip": gen,
+        "fused_bn": fused_bn,
+        "s2d_stem": s2d,
+        "loss": float(loss),
+    }

@@ -46,6 +46,12 @@ class TransformerConfig:
     attention: str = "flash"        # flash | ring | reference
     scan_layers: bool = True
     remat: bool = True
+    # Rematerialization policy: None = full recompute (max memory saving,
+    # ~4/3 extra executed FLOPs the matmul-only MFU accounting does not
+    # credit); "dots" = jax.checkpoint_policies.checkpoint_dots (save all
+    # matmul outputs, recompute only elementwise/norm/softmax — the
+    # standard transformer trade).
+    remat_policy: Optional[str] = None
     mesh: Optional[Any] = None      # required for attention="ring"
     # MoE (SURVEY.md §2.3 expert parallelism): >0 swaps the dense MLP for
     # an expert-parallel MoEMLP in every block.
@@ -253,13 +259,41 @@ class Transformer(nn.Module):
         embed = self.param("embedding", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab, cfg.dim), jnp.float32)
-        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        from flax.linen.spmd import get_logical_axis_rules
+        if get_logical_axis_rules():
+            # Sharded training (an axis-rules context is live): look up via
+            # one-hot matmul, not gather. The table is (vocab→model,
+            # embed→fsdp)-sharded while activations want batch over
+            # (data, fsdp) — GSPMD reshard s dots cleanly (psum over the
+            # contracted vocab axis + reduce-scatter) but a gather's
+            # embed-fsdp→batch-fsdp transition is an "involuntary full
+            # rematerialization": replicate-then-slice EVERY step, fwd and
+            # transpose (MULTICHIP_r04 tail; VERDICT r4 next-step #3). The
+            # one-hot term is 2·vocab·dim FLOPs/token ≈ 0.6% of a 7B step,
+            # and it rides the MXU.
+            x = jax.nn.one_hot(tokens, cfg.vocab, dtype=cfg.dtype) \
+                @ embed.astype(cfg.dtype)
+        else:
+            x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         positions = jnp.arange(t)
 
         block_cls = ScannedBlock
+        # Validated OUTSIDE the remat gate: a typo'd (or remat=False-
+        # orphaned) policy must fail loudly, not silently not-apply.
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.checkpoint_dots
+        elif cfg.remat_policy == "dots_no_batch":
+            policy = (jax.checkpoint_policies
+                      .dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy is not None:
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+        if cfg.remat_policy is not None and not cfg.remat:
+            raise ValueError("remat_policy set but remat=False")
         if cfg.remat:
-            block_cls = nn.remat(block_cls, prevent_cse=False)
+            block_cls = nn.remat(block_cls, prevent_cse=False,
+                                 policy=policy)
         if cfg.scan_layers:
             x, _ = nn.scan(
                 block_cls,
