@@ -90,6 +90,46 @@ def main() -> int:
         except Exception as e:
             result["llm_7b_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if on_tpu and os.environ.get("BENCH_LLM_MOE", "1") != "0":
+        # Mixtral-proxy sparse-MoE leg: 8 experts / top-2 / GQA kv=heads/4
+        # at the proxy decoder shapes — measures the GShard static-capacity
+        # dispatch path's single-chip efficiency.
+        saved = {k: os.environ.get(k) for k in
+                 ("BENCH_LLM_KV_HEADS", "BENCH_LLM_LAYERS",
+                  "BENCH_LLM_SCAN", "BENCH_LLM_BATCH", "BENCH_LLM_REMAT")}
+        try:
+            os.environ["BENCH_LLM_KV_HEADS"] = str(
+                max(1, int(os.environ.get("BENCH_LLM_HEADS", "8")) // 4))
+            # 6 layers, scanned: 8 experts at the proxy dims are ~104M
+            # params/layer — 12 layers of f32 adamw state exceed HBM, and
+            # the 12-layer UNROLLED graph kills the AOT compile helper.
+            os.environ["BENCH_LLM_LAYERS"] = \
+                os.environ.get("BENCH_LLM_MOE_LAYERS", "6")
+            os.environ["BENCH_LLM_SCAN"] = "1"
+            # b16: the scanned layer stack keeps whole-stack bf16 copies
+            # of the 8-expert weights as temps; b32 activations on top of
+            # those tip 16 GB HBM.
+            os.environ["BENCH_LLM_BATCH"] = \
+                os.environ.get("BENCH_LLM_MOE_BATCH", "16")
+            # Remat: without it the layer scan saves every layer's MoE
+            # dispatch/combine tensors — gigabytes of f32 — and OOMs.
+            os.environ["BENCH_LLM_REMAT"] = "1"
+            moe = bench_llm(
+                peak,
+                moe_experts=int(os.environ.get("BENCH_LLM_MOE_EXPERTS",
+                                               "8")),
+                moe_top_k=int(os.environ.get("BENCH_LLM_MOE_TOPK", "2")))
+            result["llm_moe_mfu"] = moe["llm_mfu"]
+            result["llm_moe_tokens_per_sec"] = moe["tokens_per_sec_per_chip"]
+        except Exception as e:
+            result["llm_moe_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        print(json.dumps(result), flush=True)
     return 0
 
 
@@ -172,9 +212,12 @@ def bench_llm_7b(peak: float) -> dict:
     }
 
 
-def bench_llm(peak: float) -> dict:
+def bench_llm(peak: float, moe_experts: int = 0,
+              moe_top_k: int = 2) -> dict:
     """Secondary metric: a matmul-dominated Llama-style train step (the
-    GSPMD graduation config ⑤'s single-chip core), same fencing rules."""
+    GSPMD graduation config ⑤'s single-chip core), same fencing rules.
+    ``moe_experts`` is an explicit PARAMETER, not env: the MoE leg must
+    not be able to silently convert the dense headline legs."""
     import optax
 
     from tony_tpu import train as tr
@@ -210,7 +253,8 @@ def bench_llm(peak: float) -> dict:
         n_kv_heads=kv_heads, ffn_hidden=ffn, vocab=vocab, max_seq=seq,
         attention=os.environ.get("BENCH_LLM_ATTN", "flash"),
         scan_layers=scan_layers, remat=remat, remat_policy=remat_policy,
-        xent_chunk=xent_chunk)
+        xent_chunk=xent_chunk, moe_experts=moe_experts,
+        moe_top_k=moe_top_k)
     cfg = model.cfg
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab)

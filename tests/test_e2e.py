@@ -844,3 +844,47 @@ def test_checkpoint_resume_across_gang_restart(pod, tmp_path):
     data = json.loads(results[0].read_text())
     assert data["resumed_from"] == 3
     assert data["final_step"] == 5
+
+
+def test_tpuvm_resources_and_subdivision_env(tpuvm, tmp_path):
+    """Remote-substrate passthroughs, live over fake-ssh: (a) a
+    tony.containers.resources file staged by the CLIENT reaches the
+    remote container cwd via the {wd}/resources rewrite; (b) two jax
+    workers subdividing one host emit the full libtpu process-grid env
+    (the contract pinned by unit tests, here proven end-to-end)."""
+    import io
+
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConfig
+
+    data = tmp_path / "lookup.txt"
+    data.write_text("resource-bytes\n")
+    props = tpuvm.props(**{
+        "tony.application.framework": "jax",
+        "tony.application.executes": "python check_env_indexed.py",
+        "tony.worker.instances": "2",
+        "tony.worker.tpus": "2",
+        "tony.scheduler.hosts": "127.0.0.1",
+        "tony.scheduler.host-tpus": "4",
+        "tony.scheduler.total-tpus": "4",
+        "tony.containers.resources": str(data),
+        "tony.task.heartbeat-interval-ms": "200",
+    })
+    client = TonyClient(TonyConfig(props), src_dir=WORKLOADS,
+                        workdir=tmp_path / "jobs", stream=io.StringIO())
+    assert client.run(timeout=120) == 0
+    # (a) the resource landed next to the remote src copy.
+    assert (tpuvm.remote / "resources" / "lookup.txt").is_file()
+    assert (tpuvm.remote / "src" / "lookup.txt").read_text() \
+        == "resource-bytes\n"
+    # (b) both tasks saw the uniform-subdivision libtpu env.
+    for idx in (0, 1):
+        env = json.loads((tpuvm.remote / "src" / f"env.{idx}.json")
+                         .read_text())
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+        assert env["TPU_PROCESS_BOUNDS"] == "2,1,1"
+        assert env["CLOUD_TPU_TASK_ID"] == str(idx)
+        assert env["TPU_PROCESS_PORT"] == str(8476 + idx)
+        assert env["TPU_PROCESS_ADDRESSES"] == \
+            "127.0.0.1:8476,127.0.0.1:8477"
+        assert env["TONY_RESOURCES_DIR"].endswith("/resources")

@@ -1,0 +1,11 @@
+"""Stub workload: dump the env into ./env.<task_index>.json — the
+per-task variant of check_env.py for substrates where co-hosted
+containers share a working directory (the tpu-vm remote workdir)."""
+import json
+import os
+
+idx = os.environ.get("TONY_TASK_INDEX", "x")
+tmp = f"env.{idx}.json.tmp"
+with open(tmp, "w") as f:
+    json.dump(dict(os.environ), f)
+os.rename(tmp, f"env.{idx}.json")

@@ -166,16 +166,10 @@ class Attention(nn.Module):
         v = v.reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if nkv != nh and cfg.attention == "ring" or (
-                nkv != nh and cfg.attention == "flash"
-                and cfg.mesh is not None
-                and cfg.mesh.shape.get("seq", 1) > 1):
-            # Ring attention rotates K/V around the seq axis and doesn't
-            # know GQA — repeat up to the query head count for it only.
-            # The flash kernels and reference_attention are GQA-native.
-            reps = nh // nkv
-            k = jnp.repeat(k, reps, axis=1)
-            v = jnp.repeat(v, reps, axis=1)
+        # No GQA repeat on ANY path: the flash kernels, ring attention, and
+        # reference_attention are all GQA-native (r5) — ring even ships
+        # the narrow K/V around the ICI ring, dividing rotate traffic by
+        # the group size.
         if cfg.attention == "ring":
             from tony_tpu.parallel import ring_attention_sharded
             assert cfg.mesh is not None, "attention='ring' needs cfg.mesh"

@@ -53,23 +53,34 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Exact attention where K/V are sharded along ``axis_name``; call inside
     ``shard_map``/``pmap`` with per-device shards.
 
-    Shapes (per device): q/k/v ``[batch, heads, seq_shard, head_dim]``;
-    returns ``[batch, heads, seq_shard, head_dim]`` in ``q.dtype``.
-    GQA callers repeat K/V heads up to the Q head count first.
+    Shapes (per device): q ``[batch, heads, seq_shard, head_dim]``; k/v
+    may carry FEWER heads (GQA, ``heads % kv_heads == 0``) — query head h
+    reads kv head ``h·kv/heads`` and, crucially, the blocks that rotate
+    around the ring stay at the NARROW width, so GQA divides the ICI
+    traffic by the group size instead of shipping repeated phantom heads.
+    Returns ``[batch, heads, seq_shard, head_dim]`` in ``q.dtype``.
     """
     sp = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, tq, d = q.shape
-    tk = k.shape[2]
+    hkv, tk = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    reps = h // hkv
     if scale is None:
         scale = d ** -0.5
 
+    # Zero-copy GQA: fold the group of query heads a kv head serves into
+    # the q sequence dim — [b, hkv, reps·tq, d] against [b, hkv, tk, d] is
+    # one einsum with K/V broadcast over the group, no jnp.repeat. Row
+    # r·tq+qi keeps query position qi, so the causal mask just tiles.
+    qr = q.reshape(b, hkv, reps * tq, d)
     q_pos = my * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
     k_iota = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
 
-    m0 = jnp.full((b, h, tq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
-    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    m0 = jnp.full((b, hkv, reps * tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, reps * tq, 1), jnp.float32)
+    o0 = jnp.zeros((b, hkv, reps * tq, d), jnp.float32)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def step(carry, step_idx):
@@ -79,7 +90,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             mask = q_pos >= (j * tk + k_iota)
         else:
             mask = jnp.ones((tq, tk), bool)
-        m, l, o = _block_step(q, k_blk, v_blk, m, l, o, scale, mask)
+        mask = jnp.tile(mask, (reps, 1)) if reps > 1 else mask
+        m, l, o = _block_step(qr, k_blk, v_blk, m, l, o, scale, mask)
         # Rotate K/V around the ring (skip after the last accumulation).
         k_nxt, v_nxt = jax.lax.cond(
             step_idx < sp - 1,
@@ -91,7 +103,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (_, _, m, l, o), _ = jax.lax.scan(
         step, (k, v, m0, l0, o0), jnp.arange(sp))
     out = jnp.where(l > 0, o / jnp.where(l > 0, l, 1.0), 0.0)
-    return out.astype(q.dtype)
+    return out.reshape(b, h, tq, d).astype(q.dtype)
 
 
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -102,6 +114,15 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     mesh. q/k/v are logically-global ``[batch, heads, seq, head_dim]``; the
     seq dim is sharded over ``seq_axis`` and heads over ``model_axis``."""
     dp_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    tp = mesh.shape.get(model_axis, 1) if model_axis else 1
+    if tp > 1 and k.shape[1] % tp:
+        # GQA heads must divide the tensor-parallel axis to stay narrow;
+        # when they don't (e.g. kv=2 over tp=4), repeat K/V up to the
+        # query head count — correct, just without the narrow-ring ICI
+        # saving (which is unexpressible for this sharding anyway).
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
     spec = P(dp_axes or None, model_axis, seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
     return jax.shard_map(
