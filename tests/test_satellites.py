@@ -231,3 +231,17 @@ def test_docker_wrap_command_unit():
     with pytest.raises(ValueError, match="tony.docker.containers.image"):
         docker_wrap_command(
             TonyConfig({"tony.docker.enabled": "true"}), argv)
+
+
+def test_remote_interpreter_site_flag_gated_on_pythonpath():
+    """-S (the sitecustomize latency cut) is legal remotely ONLY when
+    tony_tpu arrives via remote_pythonpath; a pip-installed remote needs
+    the site import to find tony_tpu at all."""
+    launch = ContainerLaunch(job_type="w", index=0, env={})
+    with_pp = TpuVmScheduler(hosts=["a"], remote_workdir="/tmp/tt",
+                             remote_pythonpath="/opt/tony")
+    assert "-S -m tony_tpu.executor" in with_pp.build_remote_command(
+        launch, "a")[2]
+    without_pp = TpuVmScheduler(hosts=["a"], remote_workdir="/tmp/tt")
+    remote = without_pp.build_remote_command(launch, "a")[2]
+    assert "-S" not in remote and "-m tony_tpu.executor" in remote
