@@ -206,8 +206,9 @@ class TonyClient:
         self.am_proc = subprocess.Popen(
             # -S: the AM is stdlib-only; skipping the site import (the ML
             # stack's sitecustomize costs ~1.8 s) is pure submit→running
-            # latency. Lazy imports still work: child_pythonpath appends
-            # site-packages.
+            # latency. Lazy imports still work via TONY_SITE_DIRS
+            # (control_plane_site_env above + restore_site_dirs in the AM
+            # __main__) — NOT via PYTHONPATH, which reaches user processes.
             [sys.executable, "-S", "-m", "tony_tpu.am",
              "--conf", str(self.job_dir / "client-conf.json"),
              "--app-id", self.app_id,

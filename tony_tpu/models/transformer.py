@@ -254,9 +254,26 @@ class Transformer(nn.Module):
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab, cfg.dim), jnp.float32)
         from flax.linen.spmd import get_logical_axis_rules
-        if get_logical_axis_rules():
-            # Sharded training (an axis-rules context is live): look up via
-            # one-hot matmul, not gather. The table is (vocab→model,
+
+        def _sharded_training() -> bool:
+            # True only when the rules context can actually shard the
+            # table: a live axis-rules context AND a >1-device mesh in
+            # scope (the train harness enters jax.set_mesh(mesh) around
+            # its jit). jax.device_count() is NOT the right signal — a
+            # single-device mesh on a multi-device host (or the CPU test
+            # env's 8 virtual devices with an unsharded harness) must
+            # keep the gather.
+            if not get_logical_axis_rules():
+                return False
+            m = jax.sharding.get_abstract_mesh()
+            return m is not None and not m.empty and m.size > 1
+
+        if _sharded_training():
+            # Sharded multi-device training only — on one device the
+            # one-hot costs ~18 ms/step of uncounted work at the bench
+            # shape (found as a 4.3-MFU-pt regression in r5; the train
+            # harness applies the rules context even unsharded): look up
+            # via one-hot matmul, not gather. The table is (vocab→model,
             # embed→fsdp)-sharded while activations want batch over
             # (data, fsdp) — GSPMD reshard s dots cleanly (psum over the
             # contracted vocab axis + reduce-scatter) but a gather's
