@@ -58,6 +58,22 @@ def main() -> int:
     # LAST line): the 7B leg alone compiles for minutes, and a harness
     # timeout mid-leg must not cost the already-measured numbers.
     print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_OVERLAP", "1") != "0":
+        # Comm/compute overlap leg: monolithic vs bucketed-accum step on
+        # the DP mesh (runs on CPU too — numerics pin; the speedup only
+        # means something on hardware with async collectives).
+        try:
+            from tony_tpu.benchmark import run_overlap_bench
+            ov = run_overlap_bench(on_tpu=on_tpu)
+            result["overlap_mono_step_s"] = ov["mono_step_s"]
+            result["overlap_accum_step_s"] = ov["accum_step_s"]
+            result["overlap_speedup"] = ov["speedup"]
+            result["overlap_n_buckets"] = ov["n_buckets"]
+            result["overlap_bucket_nbytes"] = ov["bucket_nbytes"]
+            result["overlap_numerics_ok"] = ov["numerics_ok"]
+        except Exception as e:  # secondary metric must not sink the bench
+            result["overlap_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

@@ -104,6 +104,7 @@ def _rename_fused(tree):
     return out
 
 
+@pytest.mark.slow
 def test_fused_resnet_matches_plain_resnet():
     """Whole-model equivalence: same params ⇒ same logits, same grads,
     same running-stat updates (f32 to isolate kernel math from bf16)."""

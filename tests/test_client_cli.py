@@ -255,6 +255,7 @@ def test_cli_kill_and_logs(tmp_path, capsys):
                      str(tmp_path / "jobs")]) == 1
 
 
+@pytest.mark.slow
 def test_cli_profile_captures_trace(tmp_path, monkeypatch):
     """`tony profile` against a detached RUNNING job: endpoint fetched over
     the new get_task_callback_info verb, synchronized capture into the
@@ -485,6 +486,7 @@ def test_client_reports_submit_to_running_latency(tmp_path):
     assert "all tasks running" in out.getvalue()
 
 
+@pytest.mark.slow
 def test_client_relaunches_crashed_am(tmp_path):
     """AM-attempt restart end-to-end (reference: the RM relaunches the AM
     container up to yarn's am max-attempts): SIGKILL the live AM process;

@@ -1,0 +1,246 @@
+"""Overlap-engine tier (comm/compute overlap tentpole): the GradBuckets
+planner, bucketed-accumulation numerics vs the monolithic step, the XLA
+flag merge, the bench leg, and the profiler's plan records — on the virtual
+8-device CPU mesh. The 1F1B-vs-GPipe numerical pins live in
+test_pipeline.py next to the schedule they pin."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tony_tpu import parallel as par
+from tony_tpu import profiler, train
+from tony_tpu.models import get_model
+from tony_tpu.parallel.overlap import (DEFAULT_BUCKET_BYTES, GradBuckets,
+                                       OVERLAP_XLA_FLAGS, microbatch_grads,
+                                       overlap_xla_flags)
+
+
+def _tree():
+    k = jax.random.split(jax.random.PRNGKey(0), 4)
+    return {
+        "a": jax.random.normal(k[0], (128, 64)),
+        "b": {"w": jax.random.normal(k[1], (256, 256)),
+              "bias": jax.random.normal(k[2], (256,))},
+        "c": jax.random.normal(k[3], (40,)),
+    }
+
+
+class TestGradBuckets:
+    def test_partitions_every_leaf_exactly_once(self):
+        tree = _tree()
+        plan = GradBuckets.plan(tree, bucket_bytes=64 * 1024)
+        seen = sorted(i for b in plan.buckets for i in b)
+        assert seen == list(range(len(jax.tree.leaves(tree))))
+
+    def test_respects_byte_threshold(self):
+        plan = GradBuckets.plan(_tree(), bucket_bytes=64 * 1024)
+        for idxs, nbytes in zip(plan.buckets, plan.bucket_nbytes):
+            # A multi-leaf bucket must fit; only a single oversized leaf
+            # may exceed (it has nowhere smaller to go).
+            assert nbytes <= plan.threshold or len(idxs) == 1
+        total = sum(l.size * l.dtype.itemsize
+                    for l in jax.tree.leaves(_tree()))
+        assert sum(plan.bucket_nbytes) == total
+
+    def test_one_dtype_per_bucket(self):
+        tree = dict(_tree(), ints=jnp.zeros((100,), jnp.int32))
+        plan = GradBuckets.plan(tree, bucket_bytes=1 << 30)
+        for idxs in plan.buckets:
+            assert len({plan.dtypes[i] for i in idxs}) == 1
+
+    def test_pack_unpack_roundtrip(self):
+        tree = _tree()
+        plan = GradBuckets.plan(tree, bucket_bytes=64 * 1024)
+        out = plan.unpack(plan.pack(tree))
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_plan_under_eval_shape(self):
+        abstract = jax.eval_shape(_tree)
+        plan = GradBuckets.plan(abstract, bucket_bytes=64 * 1024)
+        assert plan.n_buckets >= 1
+
+    def test_rejects_nonpositive_threshold(self):
+        with pytest.raises(ValueError, match="positive"):
+            GradBuckets.plan(_tree(), bucket_bytes=0)
+
+    @pytest.mark.parametrize("op", ["all_reduce", "reduce_scatter"])
+    def test_reduce_matches_tree_psum(self, op):
+        """Per-bucket reduction must equal the monolithic per-leaf psum —
+        for both the allreduce and the RS+AG split (padded buckets)."""
+        from tony_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = par.make_mesh()
+        axes = ("data", "fsdp")
+        tree = _tree()
+        plan = GradBuckets.plan(tree, bucket_bytes=64 * 1024)
+        specs = jax.tree.map(lambda _: P(), tree)
+
+        def spmd(t):
+            # Give each replica distinct values so the sum is a real test.
+            r = jax.lax.axis_index("data").astype(jnp.float32) + 1.0
+            t = jax.tree.map(lambda l: l * r, t)
+            want = jax.tree.map(lambda l: jax.lax.psum(l, axes), t)
+            got = plan.reduce(t, axes, op=op, group_size=8)
+            return want, got
+
+        want, got = jax.jit(shard_map(
+            spmd, mesh, in_specs=(specs,), out_specs=(specs, specs)))(tree)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def _mnist_setup(batch=32, hidden=64):
+    model = get_model("mnist-mlp", hidden=hidden)
+    kx, ky, kr = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (batch, 784))
+    y = jax.random.randint(ky, (batch,), 0, 10)
+    state = train.create_train_state(model, optax.sgd(0.1), x, kr)
+    return state, {"x": x, "y": y}
+
+
+@pytest.mark.parametrize("op", ["all_reduce", "reduce_scatter"])
+def test_accum_step_matches_monolithic(op):
+    """THE acceptance pin: bucketed-accumulation loss/grad-norm/params must
+    match the monolithic make_train_step within 1e-5 on the 8-device DP
+    mesh."""
+    mesh = par.make_mesh()
+    state, batch = _mnist_setup()
+    mono = train.make_train_step(mesh=mesh, donate=False)
+    accum = train.make_accum_train_step(
+        mesh=mesh, microbatches=4, bucket_bytes=32 * 1024, reduce_op=op,
+        donate=False)
+    s1, m1 = mono(state, batch)
+    s2, m2 = accum(state, batch)
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-5
+    assert abs(float(m1["grad_norm"]) - float(m2["grad_norm"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_accum_step_trains():
+    mesh = par.make_mesh()
+    state, batch = _mnist_setup()
+    step = train.make_accum_train_step(mesh=mesh, microbatches=4)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_accum_step_rejects_indivisible_batch():
+    mesh = par.make_mesh()
+    state, _ = _mnist_setup()
+    bad = {"x": jnp.zeros((24, 784)), "y": jnp.zeros((24,), jnp.int32)}
+    step = train.make_accum_train_step(mesh=mesh, microbatches=4,
+                                       donate=False)
+    with pytest.raises(ValueError, match="24.*not divisible.*32"):
+        step(state, bad)
+
+
+def test_accum_step_requires_mesh():
+    with pytest.raises(ValueError, match="mesh"):
+        train.make_accum_train_step(microbatches=4)
+
+
+def test_microbatch_grads_single_bucket_and_many():
+    """Bucketing must not change grads: one giant bucket vs per-leaf-ish
+    tiny buckets agree with each other."""
+    mesh = par.make_mesh()
+    state, batch = _mnist_setup()
+
+    def loss_fn(params, mb):
+        logits = state.apply_fn({"params": params}, mb["x"])
+        return train.cross_entropy_loss(logits, mb["y"])
+
+    def run(bucket_bytes):
+        return microbatch_grads(loss_fn, state.params, batch, mesh,
+                                microbatches=4, bucket_bytes=bucket_bytes)
+
+    loss_a, grads_a = jax.jit(lambda: run(1 << 30))()
+    loss_b, grads_b = jax.jit(lambda: run(1024))()
+    assert abs(float(loss_a) - float(loss_b)) < 1e-6
+    for a, b in zip(jax.tree.leaves(grads_a), jax.tree.leaves(grads_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_profiler_records_bucket_plan():
+    profiler.reset_overlap_records()
+    mesh = par.make_mesh()
+    state, batch = _mnist_setup()
+    step = train.make_accum_train_step(mesh=mesh, microbatches=4,
+                                       bucket_bytes=32 * 1024, donate=False)
+    step(state, batch)
+    rec = profiler.overlap_report()
+    assert "accum_step" in rec
+    assert rec["accum_step"]["n_buckets"] >= 1
+    assert sum(rec["accum_step"]["bucket_nbytes"]) == sum(
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(state.params))
+    assert rec["accum_step"]["microbatches"] == 4
+
+
+class TestOverlapXlaFlags:
+    def test_all_flags_present_on_empty(self):
+        out = overlap_xla_flags()
+        for f in OVERLAP_XLA_FLAGS:
+            assert f in out
+
+    def test_user_flag_wins(self):
+        user = "--xla_tpu_enable_latency_hiding_scheduler=false"
+        out = overlap_xla_flags(user)
+        assert "--xla_tpu_enable_latency_hiding_scheduler=false" in out
+        assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in out
+
+    def test_unrelated_user_flags_kept(self):
+        out = overlap_xla_flags("--xla_force_host_platform_device_count=8")
+        assert "--xla_force_host_platform_device_count=8" in out
+        assert "--xla_tpu_enable_async_collective_fusion=true" in out
+
+    def test_idempotent(self):
+        once = overlap_xla_flags()
+        assert overlap_xla_flags(once) == once
+
+
+def test_train_step_seq_axis_keeps_ring_sharding():
+    """Satellite pin: make_train_step(seq_axis=True) constrains the batch
+    with the sequence dim on the ring axis (it used to re-constrain
+    long-context batches OFF it) and still trains. The rank-1 "w" leaf
+    pins the leaf-rank guard: the (batch, seq) spec must not be forced
+    onto labels/weights."""
+    mesh = par.make_mesh(sp=2)
+    model = get_model("llama-tiny")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 256)
+    state = train.create_train_state(
+        model, optax.adam(1e-2), tokens, jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        loss_of=lambda logits, b: train.next_token_loss(logits, b["x"]),
+        mesh=mesh, seq_axis=True, donate=False)
+    _, metrics = step(state, {"x": tokens, "w": jnp.ones((8,))})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_run_overlap_bench_reports_and_matches():
+    """Acceptance: the bench leg on the 8-device CPU mesh reports numerics
+    matching the monolithic step and emits per-bucket bytes."""
+    import os
+
+    from tony_tpu.benchmark import run_overlap_bench
+
+    os.environ["BENCH_WINDOWS"] = "1"
+    try:
+        r = run_overlap_bench(batch=64, hidden=64, steps=1,
+                              bucket_bytes=32 * 1024)
+    finally:
+        del os.environ["BENCH_WINDOWS"]
+    assert r["numerics_ok"]
+    assert r["loss_delta"] < 1e-5 and r["grad_norm_delta"] < 1e-5
+    assert r["n_buckets"] == len(r["bucket_nbytes"]) >= 1
+    assert all(b > 0 for b in r["bucket_nbytes"])
+    assert r["mono_step_s"] > 0 and r["accum_step_s"] > 0
+    assert r["overlap_records"]["accum_step"]["n_buckets"] == r["n_buckets"]

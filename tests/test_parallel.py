@@ -42,6 +42,23 @@ def test_shard_logical_places_array():
     assert w.sharding.spec == jax.sharding.PartitionSpec("fsdp", "model")
 
 
+def test_logical_sharding_unknown_axis_raises():
+    """A typo'd logical axis used to fall through to None and silently
+    replicate the dim — it must raise, naming the bad axis."""
+    mesh = par.make_mesh(fsdp=2, tp=4)
+    with pytest.raises(ValueError, match="embde"):
+        par.logical_sharding(mesh, "embde", "ffn")
+    with pytest.raises(ValueError, match="allow_unknown"):
+        par.constraint(jnp.zeros((4, 4)), mesh, "nope", None)
+
+
+def test_logical_sharding_allow_unknown_escape_hatch():
+    mesh = par.make_mesh(fsdp=2, tp=4)
+    s = par.logical_sharding(mesh, "custom_axis", "ffn",
+                             allow_unknown=True)
+    assert s.spec == jax.sharding.PartitionSpec(None, "model")
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(causal):
     mesh = par.make_mesh(sp=8)

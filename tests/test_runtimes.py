@@ -144,6 +144,68 @@ def test_jax_mixed_tpus_cohort_gets_pinning_but_no_bounds():
     assert constants.ENV_TPU_PROCESS_BOUNDS not in env
 
 
+def test_jax_injects_overlap_xla_flags_for_tpu_tasks():
+    """TPU-resourced jax tasks get the comm/compute-overlap compiler knobs
+    (latency-hiding scheduler + async collective fusion) by default."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0,
+                conf_extra={"tony.worker.tpus": "2"}))
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" \
+        in env[constants.ENV_XLA_FLAGS]
+    assert "--xla_tpu_enable_async_collective_fusion=true" \
+        in env[constants.ENV_XLA_FLAGS]
+
+
+def test_jax_no_overlap_flags_without_tpus():
+    """Non-TPU tasks must NOT get the xla_tpu_* set: XLA aborts the
+    process on flags its build doesn't know (measured on the CPU wheel),
+    so default-injecting would kill every CPU-backend job."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0))
+    assert constants.ENV_XLA_FLAGS not in env
+
+
+def test_jax_overlap_flags_forced_on_by_conf():
+    """Whole-host TPU jobs don't set tony.<jobtype>.tpus; explicit conf
+    true forces injection."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0,
+                conf_extra={"tony.jax.overlap-xla-flags": "true"}))
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" \
+        in env[constants.ENV_XLA_FLAGS]
+
+
+def test_jax_overlap_flags_user_value_wins():
+    """A flag the user set via tony.<jobtype>.env keeps ITS value; only
+    missing flags are appended."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0, conf_extra={
+            "tony.worker.tpus": "2",
+            "tony.worker.env":
+                "XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler"
+                "=false"}))
+    flags = env[constants.ENV_XLA_FLAGS]
+    assert "--xla_tpu_enable_latency_hiding_scheduler=false" in flags
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in flags
+    assert "--xla_tpu_overlap_compute_collective_tc=true" in flags
+
+
+def test_jax_overlap_flags_conf_gated_off():
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0,
+                conf_extra={"tony.worker.tpus": "2",
+                            "tony.jax.overlap-xla-flags": "false"}))
+    assert constants.ENV_XLA_FLAGS not in env
+
+
+def test_jax_sidecar_gets_no_overlap_flags():
+    spec = dict(SPEC, tensorboard=["h9:5000"])
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "tensorboard", 0, spec=spec,
+                conf_extra={"tony.tensorboard.instances": "1"}))
+    assert constants.ENV_XLA_FLAGS not in env
+
+
 def test_jax_rejects_ps():
     fw = get_framework("jax")
     conf = TonyConfig({"tony.ps.instances": "2", "tony.worker.instances": "2"})

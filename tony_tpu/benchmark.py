@@ -96,6 +96,90 @@ def resnet_window(batch: int, image: int, steps: int, *,
     return window, (params, opt_state, batch_stats)
 
 
+def run_overlap_bench(*, batch: int | None = None, hidden: int = 512,
+                      steps: int | None = None, microbatches: int = 4,
+                      bucket_bytes: int = 1 << 20,
+                      reduce_op: str = "all_reduce",
+                      on_tpu: bool | None = None) -> dict:
+    """Overlap-engine leg: monolithic GSPMD step vs bucketed-accumulation
+    step (``make_accum_train_step``) on a pure-DP mesh over all local
+    devices, same model / optimizer / data.
+
+    Reports both step times, the speedup, the bucket plan (count and
+    per-bucket bytes — the numbers the latency-hiding scheduler pipelines),
+    and the numerics deltas between the two paths: the bucketed step must
+    match the monolithic step's loss and grad-norm within 1e-5 or the
+    comparison is void (``numerics_ok`` gates the headline).
+    """
+    import optax
+
+    from tony_tpu import parallel as par
+    from tony_tpu import profiler
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+    from tony_tpu.parallel.overlap import GradBuckets
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    if steps is None:
+        steps = 20 if on_tpu else 4
+    mesh = par.make_mesh()          # every axis 1 except data: pure DP
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    if batch is None:
+        batch = dp * microbatches * (16 if on_tpu else 4)
+    model = get_model("mnist-mlp", hidden=hidden)
+    kx, ky, kr = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (batch, 784), jnp.float32)
+    y = jax.random.randint(ky, (batch,), 0, 10)
+    data = {"x": x, "y": y}
+    state = tr.create_train_state(model, optax.sgd(0.1, momentum=0.9),
+                                  x, kr)
+    plan = GradBuckets.plan(state.params, bucket_bytes)
+
+    profiler.reset_overlap_records()
+    mono = tr.make_train_step(mesh=mesh, donate=False)
+    accum = tr.make_accum_train_step(
+        mesh=mesh, microbatches=microbatches, bucket_bytes=bucket_bytes,
+        reduce_op=reduce_op, donate=False)
+    # Numerics pin first, from the identical initial state.
+    _, m_mono = mono(state, data)
+    _, m_accum = accum(state, data)
+    loss_delta = abs(float(m_mono["loss"]) - float(m_accum["loss"]))
+    gnorm_delta = abs(float(m_mono["grad_norm"])
+                      - float(m_accum["grad_norm"]))
+
+    def timed(step_fn):
+        def window(st):
+            metrics = None
+            for _ in range(steps):
+                st, metrics = step_fn(st, data)
+            return st, metrics["loss"]
+        best, _, _ = best_window_time(window, state,
+                                      params_of=lambda s: s.params)
+        return best / steps
+
+    mono_s = timed(mono)
+    accum_s = timed(accum)
+    return {
+        "metric": "overlap_bench",
+        "mono_step_s": round(mono_s, 6),
+        "accum_step_s": round(accum_s, 6),
+        "speedup": round(mono_s / accum_s, 4) if accum_s else None,
+        "microbatches": microbatches,
+        "reduce_op": reduce_op,
+        "n_buckets": plan.n_buckets,
+        "bucket_nbytes": list(plan.bucket_nbytes),
+        "bucket_threshold": plan.threshold,
+        "loss_delta": loss_delta,
+        "grad_norm_delta": gnorm_delta,
+        "numerics_ok": bool(loss_delta < 1e-5 and gnorm_delta < 1e-5),
+        "overlap_records": profiler.overlap_report(),
+        "batch": batch,
+        "dp": dp,
+        "backend": jax.default_backend(),
+    }
+
+
 def peak_flops(on_tpu: bool | None = None) -> float:
     """THE peak-FLOPs rule for MFU accounting (single definition — every
     bench leg divides by this): the chip generation's bf16 peak on TPU, a

@@ -1,0 +1,51 @@
+"""JAX API compatibility: one place that absorbs the moving surface.
+
+The compute plane targets current JAX (``jax.shard_map``, ``jax.set_mesh``)
+but must also run on the 0.4.x line some images pin (where manual sharding
+lives in ``jax.experimental.shard_map`` and there is no ambient-mesh
+context — ``NamedSharding`` carries its mesh explicitly, so the context is
+simply not needed). Every module that manually shards goes through these
+two helpers instead of probing ``jax`` itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with per-shard replication checking off — the
+    schedules here build replication via explicit ``psum`` and assert it
+    themselves (numerical pin tests), which the checker can't see."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def ambient_mesh_size() -> int:
+    """Device count of the ambient abstract mesh (``jax.set_mesh`` scope),
+    or 0 when none is set — including on 0.4.x, where no ambient-mesh
+    concept exists (and :func:`mesh_context` is a no-op, so code gating on
+    "am I inside the sharded train harness?" correctly sees 0 there)."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None:
+        return 0
+    m = get()
+    if m is None or m.empty:
+        return 0
+    return m.size
+
+
+def mesh_context(mesh) -> Any:
+    """Ambient-mesh scope for jitted GSPMD code: ``jax.set_mesh`` where it
+    exists, a no-op otherwise (on 0.4.x the shardings baked into the jitted
+    function are explicit ``NamedSharding``s, so no scope is required)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return contextlib.nullcontext()

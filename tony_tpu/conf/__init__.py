@@ -69,6 +69,13 @@ PYTHON_BINARY = "tony.application.python-binary"              # interpreter path
 # libtpu address BEFORE launch, so these can't be executor-reserved
 # ephemerals. Conf-keyed so concurrent jobs sharing hosts stay apart.
 LIBTPU_PORT_BASE = "tony.task.libtpu.port-base"
+# JAXRuntime injects the comm/compute-overlap XLA flags (latency-hiding
+# scheduler, async collective fusion — tony_tpu.parallel.overlap) into a jax
+# task's XLA_FLAGS, merged under any flags from tony.<jobtype>.env (user-set
+# flag names win). Unset: injected iff the task requests TPUs
+# (tony.<jobtype>.tpus > 0 — the xla_tpu_* set aborts non-TPU XLA builds).
+# Explicit true/false forces it on (whole-host TPU jobs) / off.
+JAX_OVERLAP_XLA_FLAGS = "tony.jax.overlap-xla-flags"
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

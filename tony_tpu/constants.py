@@ -72,6 +72,9 @@ ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
 ENV_TPU_PROCESS_ADDRESSES = "TPU_PROCESS_ADDRESSES"
 ENV_TPU_PROCESS_PORT = "TPU_PROCESS_PORT"
 ENV_CLOUD_TPU_TASK_ID = "CLOUD_TPU_TASK_ID"
+# XLA compiler knobs (JAXRuntime injects the comm/compute-overlap set —
+# latency-hiding scheduler + async collectives — unless disabled by conf)
+ENV_XLA_FLAGS = "XLA_FLAGS"
 
 # --- Well-known job types ---------------------------------------------------
 # (reference: open-ended; these are the conventional names used by the success

@@ -265,8 +265,8 @@ class Transformer(nn.Module):
             # keep the gather.
             if not get_logical_axis_rules():
                 return False
-            m = jax.sharding.get_abstract_mesh()
-            return m is not None and not m.empty and m.size > 1
+            from tony_tpu.compat import ambient_mesh_size
+            return ambient_mesh_size() > 1
 
         if _sharded_training():
             # Sharded multi-device training only — on one device the

@@ -1132,5 +1132,6 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     fn = functools.partial(flash_attention, causal=causal, scale=scale,
                            block_q=block_q, block_k=block_k,
                            interpret=interpret)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from tony_tpu.compat import shard_map as _shard_map
+    return _shard_map(fn, mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)

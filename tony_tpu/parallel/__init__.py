@@ -121,38 +121,63 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def logical_sharding(mesh: Mesh, *logical_axes: Optional[str]) -> NamedSharding:
+def logical_sharding(mesh: Mesh, *logical_axes: Optional[str],
+                     allow_unknown: bool = False) -> NamedSharding:
     """NamedSharding for an array whose dims carry the given logical axis
-    names (None = unsharded dim), resolved through :data:`RULES`."""
+    names (None = unsharded dim), resolved through :data:`RULES`.
+
+    Unknown names raise: a typo'd axis used to fall through ``get`` to
+    ``None`` and silently replicate the dim — the worst failure mode for a
+    sharding bug (correct numbers, wrong memory/traffic). Pass
+    ``allow_unknown=True`` to deliberately leave unlisted names unsharded
+    (e.g. model code carrying axes for a rule set layered elsewhere).
+    """
     table = dict(RULES)
-    spec = tuple(table.get(ax) if ax is not None else None
-                 for ax in logical_axes)
+    spec = []
+    for ax in logical_axes:
+        if ax is None:
+            spec.append(None)
+        elif ax in table:
+            spec.append(table[ax])
+        elif allow_unknown:
+            spec.append(None)
+        else:
+            raise ValueError(
+                f"unknown logical axis {ax!r}: not in RULES "
+                f"({sorted(table)}); pass allow_unknown=True to leave it "
+                f"unsharded deliberately")
     return NamedSharding(mesh, P(*spec))
 
 
-def shard_logical(mesh: Mesh, x: jax.Array,
-                  *logical_axes: Optional[str]) -> jax.Array:
+def shard_logical(mesh: Mesh, x: jax.Array, *logical_axes: Optional[str],
+                  allow_unknown: bool = False) -> jax.Array:
     """Device-put ``x`` with :func:`logical_sharding`."""
-    return jax.device_put(x, logical_sharding(mesh, *logical_axes))
+    return jax.device_put(
+        x, logical_sharding(mesh, *logical_axes,
+                            allow_unknown=allow_unknown))
 
 
-def constraint(x: jax.Array, mesh: Mesh,
-               *logical_axes: Optional[str]) -> jax.Array:
+def constraint(x: jax.Array, mesh: Mesh, *logical_axes: Optional[str],
+               allow_unknown: bool = False) -> jax.Array:
     """``with_sharding_constraint`` through the logical-axis rules — the
     in-jit annotation that steers GSPMD."""
     return jax.lax.with_sharding_constraint(
-        x, logical_sharding(mesh, *logical_axes))
+        x, logical_sharding(mesh, *logical_axes,
+                            allow_unknown=allow_unknown))
 
 
 from tony_tpu.parallel.ring_attention import (  # noqa: E402  (re-export)
     ring_attention, ring_attention_sharded)
 from tony_tpu.parallel.pipeline import (  # noqa: E402  (re-export)
-    gpipe, pipelined_lm_logits, stage_split)
+    gpipe, gpipe_1f1b, pipelined_lm_logits, stage_split)
+from tony_tpu.parallel.overlap import (  # noqa: E402  (re-export)
+    GradBuckets, microbatch_grads, overlap_xla_flags)
 
 __all__ = [
     "AXES", "DATA", "FSDP", "PIPE", "EXPERT", "SEQ", "MODEL", "RULES",
     "MeshSpec", "make_mesh", "batch_sharding", "replicated",
     "logical_sharding", "shard_logical", "constraint",
-    "ring_attention", "ring_attention_sharded", "gpipe",
+    "ring_attention", "ring_attention_sharded", "gpipe", "gpipe_1f1b",
     "pipelined_lm_logits", "stage_split",
+    "GradBuckets", "microbatch_grads", "overlap_xla_flags",
 ]

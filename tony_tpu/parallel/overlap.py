@@ -1,0 +1,298 @@
+"""Comm/compute overlap engine: bucketed gradient sync under microbatched
+accumulation, plus the XLA scheduler knobs that make the overlap real.
+
+The seed's train step reduces gradients in one monolithic GSPMD ``psum``
+issued after the full backward — zero overlap structure, the exact thing
+Horovod's bucketed allreduce (arXiv:1802.05799) fixed for GPU rings and T3
+(arXiv:2401.16677) shows is where modern MFU headroom lives. This module
+builds that layer natively:
+
+* :class:`GradBuckets` — a Horovod-style byte-threshold bucketing plan over
+  the flattened grad pytree. Each bucket concatenates same-dtype leaves up
+  to ``bucket_bytes`` and is reduced as ONE collective, so small tensors
+  amortize launch latency and big ones don't serialize the whole sync.
+* :func:`microbatch_grads` — the accumulation step core: the local batch is
+  split into K microbatches inside one ``lax.scan``; each microbatch's
+  grads are packed and reduced per bucket (``psum`` or
+  ``psum_scatter``+``all_gather``) *inside* the scan body, so under XLA's
+  latency-hiding scheduler the reduction of microbatch *i*'s buckets
+  overlaps the backward compute of microbatch *i+1*.
+  :func:`tony_tpu.train.make_accum_train_step` wraps this into a drop-in
+  train step.
+* :func:`overlap_xla_flags` — the latency-hiding-scheduler / async
+  collective flags, merged into an ``XLA_FLAGS`` string with user-set
+  values winning; :class:`tony_tpu.runtime.jax_runtime.JAXTaskAdapter`
+  injects the result so tony-submitted jobs get the overlap for free.
+
+Scope: the engine treats the ``data`` and ``fsdp`` mesh axes as the
+gradient-sync group with params replicated inside the manually-sharded
+region (pure DP semantics — the layout ``batch_sharding`` feeds). Sharded-
+param (ZeRO-3) accumulation and cross-slice DCN bucketing are ROADMAP
+follow-ons built on this layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tony_tpu import compat
+from tony_tpu.parallel import DATA, FSDP
+
+# Horovod's fusion buffer defaults to 64 MiB for NCCL rings; ICI collectives
+# saturate earlier, and smaller buckets mean the first reduction launches
+# sooner after the first grads materialize. 4 MiB is the planner default;
+# callers tune per model via ``bucket_bytes``.
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+# The scheduler knobs (MaxText/XLA-team standard set): latency-hiding
+# scheduling so async collective pairs slide over compute, plus async
+# collective fusion so the per-bucket reduces actually become async pairs.
+# TPU-namespaced flags ONLY: XLA ABORTS the process on any flag its build
+# doesn't know (measured on the CPU wheel), so this set must never reach a
+# non-TPU jaxlib — the runtime injects it only for TPU-resourced tasks.
+OVERLAP_XLA_FLAGS: Tuple[str, ...] = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def _flag_name(flag: str) -> str:
+    return flag.lstrip("-").split("=", 1)[0]
+
+
+def overlap_xla_flags(existing: str = "") -> str:
+    """Merge :data:`OVERLAP_XLA_FLAGS` into an ``XLA_FLAGS`` string.
+
+    A flag the caller already set (any value) is kept and ours dropped —
+    injection must never override an operator's explicit tuning.
+    """
+    present = {_flag_name(f) for f in existing.split() if f.startswith("-")}
+    merged = [f for f in OVERLAP_XLA_FLAGS if _flag_name(f) not in present]
+    return " ".join(filter(None, [existing.strip(), *merged])).strip()
+
+
+def sync_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The gradient-sync mesh axes: both DP axes, in mesh order — matches
+    :func:`tony_tpu.parallel.batch_sharding`'s batch placement."""
+    return tuple(a for a in (DATA, FSDP) if a in mesh.axis_names)
+
+
+def sync_size(mesh: Mesh) -> int:
+    """Device count of the gradient-sync group (product of the DP axes) —
+    the denominator shared by the accum step and the pipeline schedules."""
+    size = 1
+    for a in sync_axes(mesh):
+        size *= mesh.shape[a]
+    return size
+
+
+@dataclass(frozen=True)
+class GradBuckets:
+    """A size-targeted partition of a grad pytree's leaves into reduction
+    buckets: every leaf lands in exactly one bucket; leaves of one dtype
+    pack together (a bucket is one concatenated 1-D buffer) in flatten
+    order until adding the next leaf would cross ``threshold`` bytes; a
+    single leaf bigger than the threshold gets a bucket of its own."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    buckets: Tuple[Tuple[int, ...], ...]   # leaf indices per bucket
+    bucket_nbytes: Tuple[int, ...]         # payload bytes per bucket
+    bucket_numel: Tuple[int, ...]          # payload elements per bucket
+    threshold: int
+
+    @classmethod
+    def plan(cls, tree: Any,
+             bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> "GradBuckets":
+        """Plan from any pytree of arrays / ShapeDtypeStructs / tracers
+        (only ``.shape``/``.dtype`` are read — works under ``eval_shape``
+        and inside a jit trace)."""
+        if bucket_bytes <= 0:
+            raise ValueError(f"bucket_bytes must be positive, got "
+                             f"{bucket_bytes}")
+        leaves, treedef = jax.tree.flatten(tree)
+        shapes = tuple(tuple(l.shape) for l in leaves)
+        dtypes = tuple(np.dtype(l.dtype) for l in leaves)
+        sizes = [int(np.prod(s, dtype=np.int64)) * d.itemsize
+                 for s, d in zip(shapes, dtypes)]
+        by_dtype: Dict[Any, list] = {}
+        for i, d in enumerate(dtypes):
+            by_dtype.setdefault(d, []).append(i)
+        buckets, nbytes, numel = [], [], []
+
+        def close(cur, cur_b, d):
+            buckets.append(tuple(cur))
+            nbytes.append(cur_b)
+            numel.append(cur_b // d.itemsize)
+
+        for d, idxs in by_dtype.items():
+            cur: list = []
+            cur_b = 0
+            for i in idxs:
+                if cur and cur_b + sizes[i] > bucket_bytes:
+                    close(cur, cur_b, d)
+                    cur, cur_b = [], 0
+                cur.append(i)
+                cur_b += sizes[i]
+            if cur:
+                close(cur, cur_b, d)
+        return cls(treedef, shapes, dtypes, tuple(buckets), tuple(nbytes),
+                   tuple(numel), bucket_bytes)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    def pack(self, tree: Any) -> list:
+        """Pytree → per-bucket 1-D concatenated buffers."""
+        leaves = jax.tree.leaves(tree)
+        return [jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+                if len(idxs) > 1 else leaves[idxs[0]].reshape(-1)
+                for idxs in self.buckets]
+
+    def unpack(self, bufs: Sequence[jax.Array]) -> Any:
+        """Per-bucket buffers → pytree (inverse of :meth:`pack`)."""
+        leaves: list = [None] * len(self.shapes)
+        for buf, idxs in zip(bufs, self.buckets):
+            off = 0
+            for i in idxs:
+                n = int(np.prod(self.shapes[i], dtype=np.int64))
+                leaves[i] = jax.lax.dynamic_slice_in_dim(
+                    buf, off, n).reshape(self.shapes[i])
+                off += n
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    def reduce(self, tree: Any, axis_names: Tuple[str, ...], *,
+               op: str = "all_reduce", group_size: int = 1) -> Any:
+        """Explicit per-bucket cross-replica sum of ``tree`` (must be
+        called inside a manually-sharded region over ``axis_names``).
+
+        ``op="all_reduce"``: one ``psum`` per bucket.
+        ``op="reduce_scatter"``: ``psum_scatter`` per (padded) bucket +
+        one tail ``all_gather`` — the bandwidth-optimal RS+AG split of an
+        allreduce; ``group_size`` must be the product of the axis sizes.
+        """
+        bufs = self.pack(tree)
+        if op == "all_reduce":
+            return self.unpack([jax.lax.psum(b, axis_names) for b in bufs])
+        if op != "reduce_scatter":
+            raise ValueError(f"unknown reduce op {op!r} "
+                             "(all_reduce|reduce_scatter)")
+        out = []
+        for b in bufs:
+            n = b.shape[0]
+            pad = (-n) % group_size
+            if pad:
+                b = jnp.concatenate([b, jnp.zeros((pad,), b.dtype)])
+            shard = jax.lax.psum_scatter(b, axis_names, tiled=True)
+            full = jax.lax.all_gather(shard, axis_names, tiled=True)
+            out.append(full[:n] if pad else full)
+        return self.unpack(out)
+
+
+def _record(tag: str, **fields) -> None:
+    # Trace-time side channel into the profiler registry (lazy import:
+    # parallel must stay importable without the profiler stack).
+    try:
+        from tony_tpu import profiler
+        profiler.record_overlap(tag, **fields)
+    except Exception:   # noqa: BLE001 — bookkeeping must never sink a step
+        pass
+
+
+def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
+                     batch: Any, mesh: Mesh, *, microbatches: int,
+                     buckets: Optional[GradBuckets] = None,
+                     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                     reduce_op: str = "all_reduce",
+                     has_aux: bool = False):
+    """Gradient accumulation over ``microbatches`` with per-bucket sync.
+
+    ``loss_fn(params, microbatch) -> loss`` (or ``(loss, aux)`` with
+    ``has_aux``) is the per-shard loss — a *mean* over its microbatch
+    slice, collective-free (the engine owns all cross-device traffic, like
+    ``gpipe``'s ``stage_fn`` contract). Params are replicated across the
+    sync axes inside the region; the batch's leading dim is split over
+    them. Returns ``(loss, grads)`` (or ``(loss, aux, grads)``): the
+    global-mean loss and grads, replicated — numerically the monolithic
+    full-batch step up to fp reassociation.
+
+    Inside the scan body each microbatch's grads are reduced bucket by
+    bucket, so the collective for microbatch *i* is in flight while
+    microbatch *i+1*'s forward/backward computes (the Horovod overlap,
+    expressed for XLA's latency-hiding scheduler — see
+    :func:`overlap_xla_flags`).
+    """
+    axes = sync_axes(mesh)
+    group = sync_size(mesh)
+    lead = jax.tree.leaves(batch)[0].shape[0]
+    if lead % (group * microbatches):
+        raise ValueError(
+            f"global batch {lead} not divisible by sync group {group} x "
+            f"microbatches {microbatches} (= {group * microbatches})")
+    plan = buckets if buckets is not None else GradBuckets.plan(
+        params, bucket_bytes)
+    _record("accum_step", n_buckets=plan.n_buckets,
+            bucket_nbytes=list(plan.bucket_nbytes),
+            threshold=plan.threshold, microbatches=microbatches,
+            reduce_op=reduce_op, sync_group=group)
+    p_specs = jax.tree.map(lambda _: P(), params)
+    b_specs = jax.tree.map(lambda _: P(axes), batch)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+
+    def spmd(params, local):
+        mbs = jax.tree.map(
+            lambda x: x.reshape((microbatches, x.shape[0] // microbatches)
+                                + x.shape[1:]), local)
+        acc0 = []
+        for idxs, n in zip(plan.buckets, plan.bucket_numel):
+            dt = plan.dtypes[idxs[0]]
+            if reduce_op == "reduce_scatter":
+                n = (n + ((-n) % group)) // group   # padded local shard
+            acc0.append(jnp.zeros((n,), dt))
+
+        def body(carry, mb):
+            loss_acc, aux_acc, acc = carry
+            out, grads = grad_fn(params, mb)
+            loss, aux = out if has_aux else (out, jnp.float32(0.0))
+            bufs = plan.pack(grads)
+            nxt = []
+            for a, b in zip(acc, bufs):
+                if reduce_op == "reduce_scatter":
+                    pad = (-b.shape[0]) % group
+                    if pad:
+                        b = jnp.concatenate(
+                            [b, jnp.zeros((pad,), b.dtype)])
+                    nxt.append(a + jax.lax.psum_scatter(b, axes,
+                                                        tiled=True))
+                else:
+                    nxt.append(a + jax.lax.psum(b, axes))
+            return (loss_acc + loss, aux_acc + aux, nxt), None
+
+        (loss, aux, acc), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0), acc0), mbs)
+        if reduce_op == "reduce_scatter":
+            acc = [jax.lax.all_gather(a, axes, tiled=True)[:n]
+                   for a, n in zip(acc, plan.bucket_numel)]
+        denom = microbatches * group
+        grads = jax.tree.map(lambda b: b / denom, plan.unpack(acc))
+        loss = jax.lax.psum(loss, axes) / denom
+        aux = jax.lax.psum(aux, axes) / denom
+        return loss, aux, grads
+
+    loss, aux, grads = compat.shard_map(
+        spmd, mesh, in_specs=(p_specs, b_specs),
+        out_specs=(P(), P(), p_specs))(params, batch)
+    if has_aux:
+        return loss, aux, grads
+    return loss, grads

@@ -15,17 +15,44 @@ Schedule: classic GPipe fill/drain. With S stages and M microbatches the
 scan runs ``M + S - 1`` ticks; stage 0 ingests microbatch ``t`` at tick
 ``t``, stage ``S-1`` emits microbatch ``t-(S-1)``'s result; bubble fraction
 is ``(S-1)/(M+S-1)`` — callers pick ``M ≥ 4·S`` to amortize.
+
+:func:`gpipe_1f1b` is the memory-lean upgrade: a ``jax.custom_vjp`` over
+the same ring whose backward is an explicitly scheduled reverse pipeline
+with stage-granularity rematerialization (the 1F1B discipline: in the
+steady state each stage runs one recompute-forward and one backward per
+tick). ``gpipe`` stays as the reference implementation it is numerically
+pinned against.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tony_tpu.parallel import DATA, FSDP, PIPE
+from tony_tpu import compat
+from tony_tpu.parallel import DATA, FSDP, PIPE  # noqa: F401 (PIPE is API)
+from tony_tpu.parallel.overlap import (_record as _record_schedule,
+                                       sync_axes, sync_size)
+
+
+def _local_batch(x: jax.Array, dp_size: int, microbatches: int) -> int:
+    """Per-DP-group batch size, validated: an indivisible global batch
+    must fail loudly (floor-division silently DROPPED the remainder rows
+    of every DP group before this check existed)."""
+    if x.shape[0] % dp_size:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by the DP group "
+            f"count {dp_size}; {x.shape[0] % dp_size} rows would be "
+            f"silently dropped")
+    local = x.shape[0] // dp_size
+    if local % microbatches:
+        raise ValueError(
+            f"per-DP-group batch {local} (global {x.shape[0]} / dp "
+            f"{dp_size}) not divisible by microbatches={microbatches}")
+    return local
 
 
 def stage_split(params: Any, n_stages: int) -> Any:
@@ -63,15 +90,8 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
     over ``pipe_axis`` (like any GSPMD activation).
     """
     n_stages = mesh.shape[pipe_axis]
-    dp_axes = tuple(a for a in (DATA, FSDP) if a in mesh.axis_names)
-    dp_size = 1
-    for a in dp_axes:
-        dp_size *= mesh.shape[a]
-    local = x.shape[0] // dp_size
-    if local % microbatches:
-        raise ValueError(
-            f"per-DP-group batch {local} (global {x.shape[0]} / dp "
-            f"{dp_size}) not divisible by microbatches={microbatches}")
+    dp_axes, dp_size = sync_axes(mesh), sync_size(mesh)
+    local = _local_batch(x, dp_size, microbatches)
     x_spec = P(dp_axes or None)
     p_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
 
@@ -112,9 +132,158 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         outs = jax.lax.psum(outs, pipe_axis)
         return outs.reshape(x_local.shape)
 
-    return jax.shard_map(
-        spmd, mesh=mesh, in_specs=(p_specs, x_spec), out_specs=x_spec,
-        check_vma=False)(stage_params, x)
+    _record_schedule("gpipe", stages=n_stages, microbatches=microbatches,
+                     ticks=microbatches + n_stages - 1)
+    return compat.shard_map(
+        spmd, mesh, in_specs=(p_specs, x_spec),
+        out_specs=x_spec)(stage_params, x)
+
+
+def gpipe_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
+               stage_params: Any, x: jax.Array, mesh: Mesh, *,
+               microbatches: int, pipe_axis: str = PIPE) -> jax.Array:
+    """GPipe ring with a 1F1B-disciplined backward via ``jax.custom_vjp``.
+
+    Same contract and forward schedule (and therefore identical outputs)
+    as :func:`gpipe`; the difference is who owns the backward. ``gpipe``
+    leaves it to autodiff, which saves every scan tick's full ``stage_fn``
+    residuals — ``(M+S-1)`` microbatches' worth of stage-internal
+    activations per stage. Here the forward saves ONLY each microbatch's
+    stage *input* (``M`` small buffers), and the backward is an explicitly
+    scheduled reverse pipeline: cotangents enter at stage ``S-1`` and ride
+    the reversed ring; each tick a stage recomputes one microbatch's
+    forward under ``jax.vjp`` and immediately consumes it (the
+    one-forward-one-backward steady state), so full stage-internal
+    residency drops from ``M`` in-flight microbatches to the single
+    microbatch being rematerialized. Bubble is unchanged — the win is
+    activation memory, which is what caps ``M`` (and a bigger ``M`` is
+    what shrinks the fill/drain bubble ``(S-1)/(M+S-1)``).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    dp_axes, dp_size = sync_axes(mesh), sync_size(mesh)
+    _local_batch(x, dp_size, microbatches)
+    m = microbatches
+    x_spec = P(dp_axes or None)
+    p_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
+    # Saved stage inputs: per device [1, M, mb...] -> global [S, M, ...]
+    # with the batch dim still on the DP axes (same trick as the [S, ...]
+    # stage params: the leading axis IS the pipe placement).
+    saved_spec = P(pipe_axis, None, dp_axes or None)
+    _record_schedule("gpipe_1f1b", stages=n_stages, microbatches=m,
+                     ticks=2 * (m + n_stages - 1))
+
+    def fwd_spmd(params, x_local):
+        params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        idx = jax.lax.axis_index(pipe_axis)
+        mbs = x_local.reshape((m, x_local.shape[0] // m)
+                              + x_local.shape[1:])
+        outs0 = jnp.zeros_like(mbs)
+        saved0 = jnp.zeros_like(mbs)
+        buf0 = jnp.zeros_like(mbs[0])
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            buf, outs, saved = carry
+            inp = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.minimum(t, m - 1), 0, keepdims=False)
+            cur = jnp.where(idx == 0, inp, buf)
+            # This stage sees microbatch t-idx this tick; bank its input
+            # (the only residual the backward needs).
+            midx = jnp.clip(t - idx, 0, m - 1)
+            valid = jnp.logical_and(t - idx >= 0, t - idx < m)
+            prev = jax.lax.dynamic_index_in_dim(saved, midx, 0,
+                                                keepdims=False)
+            saved = jax.lax.dynamic_update_index_in_dim(
+                saved, jnp.where(valid, cur, prev), midx, 0)
+            y = stage_fn(params, cur)
+            oidx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            emit = jnp.logical_and(idx == n_stages - 1, t >= n_stages - 1)
+            prev_o = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
+                                                  keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(emit, y, prev_o), oidx, 0)
+            buf = jax.lax.ppermute(y, pipe_axis, perm)
+            return (buf, outs, saved), None
+
+        (_, outs, saved), _ = jax.lax.scan(
+            tick, (buf0, outs0, saved0), jnp.arange(m + n_stages - 1))
+        outs = jax.lax.psum(outs, pipe_axis)
+        return outs.reshape(x_local.shape), saved[None]
+
+    def bwd_spmd(params, saved, dy_local):
+        params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        saved = jnp.squeeze(saved, 0)
+        idx = jax.lax.axis_index(pipe_axis)
+        dys = dy_local.reshape((m, dy_local.shape[0] // m)
+                               + dy_local.shape[1:])
+        dxs0 = jnp.zeros_like(dys)
+        buf0 = jnp.zeros_like(dys[0])
+        dp0 = jax.tree.map(jnp.zeros_like, params)
+        # Reverse ring: stage i+1's input-cotangent is stage i's
+        # output-cotangent.
+        perm = [(i + 1, i) for i in range(n_stages - 1)]
+
+        def tick(carry, u):
+            buf, dparams, dxs = carry
+            # Stage s handles microbatch u-(S-1-s): microbatch 0's
+            # cotangent enters at stage S-1 at tick 0 and reaches stage 0
+            # at tick S-1 — the mirror of the forward fill.
+            rel = u - (n_stages - 1 - idx)
+            valid = jnp.logical_and(rel >= 0, rel < m)
+            midx = jnp.clip(rel, 0, m - 1)
+            ct = jnp.where(idx == n_stages - 1,
+                           jax.lax.dynamic_index_in_dim(dys, midx, 0,
+                                                        keepdims=False),
+                           buf)
+            x_in = jax.lax.dynamic_index_in_dim(saved, midx, 0,
+                                                keepdims=False)
+            # Recompute-forward + backward for ONE microbatch (the 1F1B
+            # steady state): residency is this tick's residuals only.
+            _, vjp = jax.vjp(stage_fn, params, x_in)
+            dp, dx = vjp(ct)
+            dparams = jax.tree.map(
+                lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
+                dparams, dp)
+            emit = jnp.logical_and(idx == 0, valid)
+            prev = jax.lax.dynamic_index_in_dim(dxs, midx, 0,
+                                                keepdims=False)
+            dxs = jax.lax.dynamic_update_index_in_dim(
+                dxs, jnp.where(emit, dx, prev), midx, 0)
+            buf = jax.lax.ppermute(dx, pipe_axis, perm)
+            return (buf, dparams, dxs), None
+
+        (_, dparams, dxs), _ = jax.lax.scan(
+            tick, (buf0, dp0, dxs0), jnp.arange(m + n_stages - 1))
+        dxs = jax.lax.psum(dxs, pipe_axis)
+        if dp_axes:
+            # Each DP group saw its own batch shard; the stage's param
+            # grad is the sum over groups (the reduction GSPMD inserts
+            # for gpipe's autodiff backward).
+            dparams = jax.lax.psum(dparams, dp_axes)
+        return (jax.tree.map(lambda a: a[None], dparams),
+                dxs.reshape(dy_local.shape))
+
+    @jax.custom_vjp
+    def run(params, x):
+        y, _ = compat.shard_map(
+            fwd_spmd, mesh, in_specs=(p_specs, x_spec),
+            out_specs=(x_spec, saved_spec))(params, x)
+        return y
+
+    def run_fwd(params, x):
+        y, saved = compat.shard_map(
+            fwd_spmd, mesh, in_specs=(p_specs, x_spec),
+            out_specs=(x_spec, saved_spec))(params, x)
+        return y, (params, saved)
+
+    def run_bwd(res, dy):
+        params, saved = res
+        return compat.shard_map(
+            bwd_spmd, mesh, in_specs=(p_specs, saved_spec, x_spec),
+            out_specs=(p_specs, x_spec))(params, saved, dy)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stage_params, x)
 
 
 def pipelined_lm_logits(params: Any, tokens: jax.Array, cfg: Any,

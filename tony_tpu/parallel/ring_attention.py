@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tony_tpu import compat
+
 _NEG_INF = -1e30
 
 
@@ -125,6 +127,5 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         v = jnp.repeat(v, reps, axis=1)
     spec = P(dp_axes or None, model_axis, seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)(q, k, v)
+    return compat.shard_map(
+        fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)

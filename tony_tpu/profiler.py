@@ -37,6 +37,32 @@ _TRACE_OPTIONS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Overlap-engine instrumentation (the comm/compute overlap tentpole): the
+# engine's planners call :func:`record_overlap` at TRACE time — once per
+# compile, not per step — so per-bucket collective sizes and schedule tick
+# counts are inspectable next to the xplane traces without parsing HLO.
+# Keyed by tag ("accum_step", "gpipe", "gpipe_1f1b"); last plan per tag
+# wins (a recompile IS a new plan).
+OVERLAP_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_overlap(tag: str, **fields) -> None:
+    """Bank one overlap plan/schedule record (bucket count & bytes,
+    microbatches, reduce op, schedule tick count...)."""
+    OVERLAP_RECORDS[tag] = dict(fields)
+
+
+def overlap_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded overlap plan (deep-copied: callers
+    serialize this into bench/metrics JSON)."""
+    return {k: dict(v) for k, v in OVERLAP_RECORDS.items()}
+
+
+def reset_overlap_records() -> None:
+    OVERLAP_RECORDS.clear()
+
+
 def _trace_fn():
     """Resolve a capture callable ``(addr, logdir, duration_ms) -> None``.
     Import is deferred and gated: the profiler client is an optional

@@ -133,6 +133,23 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
                 env[constants.ENV_TPU_PROCESS_ADDRESSES] = ",".join(addrs)
                 env[constants.ENV_TPU_PROCESS_PORT] = str(base + rank)
                 env[constants.ENV_CLOUD_TPU_TASK_ID] = str(rank)
+        # Comm/compute overlap (tony_tpu.parallel.overlap): inject the
+        # latency-hiding-scheduler / async-collective XLA flags so
+        # tony-submitted TPU jobs overlap gradient sync with backward
+        # compute by default. TPU-resourced tasks only unless forced by
+        # conf: XLA aborts on flags its build doesn't know, so the
+        # xla_tpu_* set would KILL a CPU-backend task at import. Merged
+        # UNDER any XLA_FLAGS from tony.<jobtype>.env (framework env wins
+        # the final build_task_env merge, so the merge happens here, with
+        # user flag names taking precedence).
+        overlap_set = ctx.conf.get(conf_mod.JAX_OVERLAP_XLA_FLAGS)
+        inject = (ctx.conf.get_bool(conf_mod.JAX_OVERLAP_XLA_FLAGS)
+                  if overlap_set is not None else tpus > 0)
+        if inject:
+            from tony_tpu.parallel.overlap import overlap_xla_flags
+            user_flags = ctx.conf.task_env(ctx.job_type).get(
+                constants.ENV_XLA_FLAGS, "")
+            env[constants.ENV_XLA_FLAGS] = overlap_xla_flags(user_flags)
         # Profiler hook (SURVEY.md §5.1): tony_tpu.distributed.initialize
         # starts jax.profiler.start_server on this port in the user
         # process. The port is executor-reserved and EPHEMERAL (shipped to

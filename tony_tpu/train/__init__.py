@@ -27,6 +27,8 @@ from flax.training.train_state import TrainState
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tony_tpu import parallel as par
+from tony_tpu.compat import mesh_context
+from tony_tpu.parallel import overlap
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -113,7 +115,7 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
                               params, shardings)
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
-    with jax.set_mesh(mesh):
+    with mesh_context(mesh):
         return jax.jit(make)(rng)
 
 
@@ -122,16 +124,21 @@ def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
                     mesh: Optional[Mesh] = None,
                     rules=par.RULES,
                     donate: bool = True,
+                    seq_axis: bool = False,
                     apply_kwargs_of: Optional[Callable[
                         [Dict[str, jax.Array]], Dict[str, Any]]] = None):
     """Build the jitted train step ``(state, batch) -> (state, metrics)``.
 
     ``loss_of(logits, batch)`` defaults to classification cross entropy on
     ``batch={'x', 'y'}``. With a mesh, the batch is constrained onto the DP
-    axes so GSPMD shards compute and allreduces grads over ICI.
-    ``apply_kwargs_of(batch)`` feeds extra kwargs to the model (e.g.
-    ``targets`` for a model with a fused head+loss — ``loss_of`` then
-    receives the model's scalar loss as its first argument).
+    axes so GSPMD shards compute and allreduces grads over ICI;
+    ``seq_axis=True`` additionally keeps the sequence dim on the ring axis
+    — long-context batches fed via ``global_batch(..., seq_axis=True)``
+    were being re-constrained OFF the ring axis inside the step before
+    this kwarg existed. ``apply_kwargs_of(batch)`` feeds extra kwargs to
+    the model (e.g. ``targets`` for a model with a fused head+loss —
+    ``loss_of`` then receives the model's scalar loss as its first
+    argument).
     """
     if loss_of is None:
         loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
@@ -140,7 +147,10 @@ def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
         if mesh is not None:
             batch = jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
-                    x, par.batch_sharding(mesh)), batch)
+                    # The (batch, seq) spec is rank-2: rank-1 leaves
+                    # (labels, weights) take the plain batch sharding.
+                    x, par.batch_sharding(
+                        mesh, seq_axis=seq_axis and x.ndim >= 2)), batch)
 
         def loss_fn(params):
             extra = apply_kwargs_of(batch) if apply_kwargs_of else {}
@@ -168,7 +178,73 @@ def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
         return jitted
 
     def stepper(state, batch):
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
+            return jitted(state, batch)
+    return stepper
+
+
+def make_accum_train_step(loss_of: Callable[[jax.Array,
+                                             Dict[str, jax.Array]],
+                                            jax.Array] = None,
+                          mesh: Mesh = None,
+                          *,
+                          microbatches: int,
+                          bucket_bytes: int = overlap.DEFAULT_BUCKET_BYTES,
+                          reduce_op: str = "all_reduce",
+                          donate: bool = True,
+                          apply_kwargs_of: Optional[Callable[
+                              [Dict[str, jax.Array]],
+                              Dict[str, Any]]] = None):
+    """Microbatched-accumulation train step with bucketed gradient sync —
+    the comm/compute-overlap counterpart of :func:`make_train_step`.
+
+    Same ``(state, batch) -> (state, metrics)`` contract and numerics
+    (loss/grads match the monolithic step to fp reassociation), but the
+    local batch is split into ``microbatches`` inside one ``lax.scan`` and
+    the DP/FSDP gradient reduction is issued per size-targeted bucket as
+    each microbatch's backward finishes —
+    :func:`tony_tpu.parallel.overlap.microbatch_grads` is the engine;
+    :func:`~tony_tpu.parallel.overlap.overlap_xla_flags` supplies the XLA
+    knobs that turn the structure into actual overlap on TPU.
+
+    Differences from the monolithic step: a mesh is required (the engine
+    owns the collectives); params must be replicated over the DP axes
+    (``batch_sharding`` layout — sharded-param accumulation is a ROADMAP
+    follow-on); the model must be collective-free inside (same contract
+    as ``gpipe``'s ``stage_fn``).
+    """
+    if mesh is None:
+        raise ValueError("make_accum_train_step requires a mesh: the "
+                         "bucketed reduction IS the cross-device sync")
+    if loss_of is None:
+        loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        def loss_fn(params, mb):
+            extra = apply_kwargs_of(mb) if apply_kwargs_of else {}
+            # No logical_axis_rules scope: inside the manually-sharded
+            # region GSPMD constraints don't apply (with no rules active,
+            # flax's with_logical_constraint is a no-op).
+            logits, sown = state.apply_fn(
+                {"params": params}, mb["x"], mutable="losses", **extra)
+            aux = sum((leaf.sum() for leaf in
+                       jax.tree.leaves(sown.get("losses", {}))),
+                      start=jnp.float32(0.0))
+            return loss_of(logits, mb) + aux, aux
+
+        loss, aux, grads = overlap.microbatch_grads(
+            loss_fn, state.params, batch, mesh,
+            microbatches=microbatches, bucket_bytes=bucket_bytes,
+            reduce_op=reduce_op, has_aux=True)
+        new_state = state.apply_gradients(grads=grads)
+        gnorm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": gnorm,
+                           "aux_loss": aux}
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def stepper(state, batch):
+        with mesh_context(mesh):
             return jitted(state, batch)
     return stepper
 
@@ -177,7 +253,9 @@ def global_batch(mesh: Mesh, local_batch: Dict[str, Any],
                  seq_axis: bool = False) -> Dict[str, jax.Array]:
     """Assemble the logically-global batch from this process's local shard —
     every process calls this with its own slice (multi-host feeding)."""
-    sharding = par.batch_sharding(mesh, seq_axis=seq_axis)
-    return jax.tree.map(
-        lambda x: jax.make_array_from_process_local_data(sharding, x),
-        local_batch)
+    def put(x):
+        # Rank-1 leaves (labels, weights) can't carry the seq dim.
+        sharding = par.batch_sharding(
+            mesh, seq_axis=seq_axis and x.ndim >= 2)
+        return jax.make_array_from_process_local_data(sharding, x)
+    return jax.tree.map(put, local_batch)
