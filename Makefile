@@ -4,11 +4,22 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-slow quick test
+.PHONY: tier1 tier1-verify tier1-multislice tier1-slow quick test
+
+# THE gate: the verbatim ROADMAP command, then the explicit multislice leg
+# (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh) so a
+# regression there fails the make target by name, not just as one more dot.
+tier1: tier1-verify tier1-multislice
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
-tier1:
+tier1-verify:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Multi-slice marker leg (also inside tier1-verify's 'not slow' selection;
+# standalone so the hierarchical/ZeRO-3 gate is visible and can be run
+# alone while iterating on the overlap engine).
+tier1-multislice:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m multislice -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

@@ -74,6 +74,59 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["overlap_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    n_dev = len(jax.devices())
+    if os.environ.get("BENCH_OVERLAP_HIER", "1") != "0" and n_dev % 2 == 0 \
+            and n_dev >= 2:
+        # Hierarchical ICI/DCN leg on a (simulated) 2-slice mesh: the
+        # per-bucket psum_scatter-over-ICI + DCN-allreduce schedule vs the
+        # same accum step with the flat single-level reduce. On one host
+        # both axes are ICI — the numerics pin is real, the DCN timing
+        # story needs a real multi-slice pod.
+        try:
+            from tony_tpu.benchmark import run_overlap_bench
+            hier = run_overlap_bench(slices=2, on_tpu=on_tpu)
+            flat = run_overlap_bench(slices=2, hierarchy="flat",
+                                     on_tpu=on_tpu)
+            result["overlap_hier_step_s"] = hier["accum_step_s"]
+            result["overlap_hier_flat_step_s"] = flat["accum_step_s"]
+            result["overlap_hier_numerics_ok"] = (
+                hier["numerics_ok"] and flat["numerics_ok"])
+            result["overlap_hier_levels"] = hier["overlap_records"][
+                "accum_step"]["levels"]
+        except Exception as e:
+            result["overlap_hier_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+    # Largest power-of-two fsdp degree (<=4) the device count divides —
+    # min(4, n_dev) broke on counts like 6.
+    zero3_fsdp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    if os.environ.get("BENCH_OVERLAP_ZERO3", "1") != "0" and zero3_fsdp > 1:
+        # ZeRO-3 leg: fsdp-sharded params, grads psum_scatter-ed straight
+        # into the shard layout inside the accum scan.
+        try:
+            from tony_tpu.benchmark import run_overlap_bench
+            z = run_overlap_bench(fsdp=zero3_fsdp, zero3=True,
+                                  on_tpu=on_tpu)
+            result["overlap_zero3_step_s"] = z["accum_step_s"]
+            result["overlap_zero3_mono_step_s"] = z["mono_step_s"]
+            result["overlap_zero3_numerics_ok"] = z["numerics_ok"]
+            result["overlap_zero3_scatter_buckets"] = z["n_scatter_buckets"]
+        except Exception as e:
+            result["overlap_zero3_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+    sweep_env = os.environ.get("BENCH_OVERLAP_SWEEP", "")
+    if sweep_env:
+        # csv of bucket-bytes thresholds, e.g. "65536,1048576,4194304" —
+        # prints its own JSON line (the sweep is a tuning curve, not a
+        # headline key).
+        try:
+            from tony_tpu.benchmark import run_overlap_sweep
+            sw = run_overlap_sweep(
+                tuple(int(s) for s in sweep_env.split(",") if s),
+                on_tpu=on_tpu)
+            print(json.dumps(sw), flush=True)
+        except Exception as e:
+            result["overlap_sweep_error"] = f"{type(e).__name__}: {e}"
+            print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

@@ -13,7 +13,9 @@ import pytest
 from tony_tpu import parallel as par
 from tony_tpu import profiler, train
 from tony_tpu.models import get_model
+from tony_tpu.parallel import overlap
 from tony_tpu.parallel.overlap import (DEFAULT_BUCKET_BYTES, GradBuckets,
+                                       MULTISLICE_XLA_FLAGS,
                                        OVERLAP_XLA_FLAGS, microbatch_grads,
                                        overlap_xla_flags)
 
@@ -66,6 +68,82 @@ class TestGradBuckets:
     def test_rejects_nonpositive_threshold(self):
         with pytest.raises(ValueError, match="positive"):
             GradBuckets.plan(_tree(), bucket_bytes=0)
+
+    def test_rejects_empty_pytree(self):
+        """Satellite pin: an empty grad tree must fail at plan time with a
+        clear message, not later inside pack/unpack with an opaque
+        concatenate error."""
+        for empty in ({}, [], {"a": {}}):
+            with pytest.raises(ValueError, match="empty"):
+                GradBuckets.plan(empty)
+
+    def test_reduce_scatter_pads_group_indivisible_buckets(self):
+        """Satellite pin: bucket payloads NOT divisible by the sync group
+        (prime-ish leaf sizes) take the padding path and still match the
+        per-leaf psum exactly."""
+        from jax.sharding import PartitionSpec as P
+
+        from tony_tpu.compat import shard_map
+
+        k = jax.random.split(jax.random.PRNGKey(3), 3)
+        tree = {"a": jax.random.normal(k[0], (37,)),
+                "b": jax.random.normal(k[1], (13, 7)),
+                "c": jax.random.normal(k[2], (5,))}
+        mesh = par.make_mesh()
+        axes = overlap.sync_axes(mesh)
+        # Tiny threshold: several buckets, each needing its own padding.
+        plan = GradBuckets.plan(tree, bucket_bytes=256)
+        assert plan.n_buckets > 1
+        assert any(n % 8 for n in plan.bucket_numel)
+        specs = jax.tree.map(lambda _: P(), tree)
+
+        def spmd(t):
+            r = jax.lax.axis_index("data").astype(jnp.float32) + 1.0
+            t = jax.tree.map(lambda l: l * r, t)
+            want = jax.tree.map(lambda l: jax.lax.psum(l, axes), t)
+            got = plan.reduce(t, axes, op="reduce_scatter", group_size=8)
+            return want, got
+
+        want, got = jax.jit(shard_map(
+            spmd, mesh, in_specs=(specs,), out_specs=(specs, specs)))(tree)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
+class TestSyncAxes:
+    """Satellite pins: the sync-group helpers on meshes that don't carry
+    every DP axis (manual meshes from user code)."""
+
+    def test_mesh_missing_fsdp(self):
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(4, 2), ("data", "model"))
+        assert overlap.sync_axes(mesh) == ("data",)
+        assert overlap.sync_size(mesh) == 4
+        assert overlap.ici_axes(mesh) == ("data",)
+        assert overlap.dcn_axis(mesh) is None
+
+    def test_mesh_missing_data(self):
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(2, 4), ("fsdp", "model"))
+        assert overlap.sync_axes(mesh) == ("fsdp",)
+        assert overlap.sync_size(mesh) == 2
+
+    def test_mesh_with_neither_dp_axis(self):
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(8,), ("model",))
+        assert overlap.sync_axes(mesh) == ()
+        assert overlap.sync_size(mesh) == 1
+
+    def test_slice_axis_in_sync_group_but_not_ici(self):
+        mesh = par.make_mesh(slices=2)
+        assert overlap.sync_axes(mesh) == ("slice", "data", "fsdp")
+        assert overlap.sync_size(mesh) == 8
+        assert overlap.ici_axes(mesh) == ("data", "fsdp")
+        assert overlap.dcn_axis(mesh) == "slice"
+
+    def test_single_slice_mesh_has_no_dcn(self):
+        assert overlap.dcn_axis(par.make_mesh()) is None
 
     @pytest.mark.parametrize("op", ["all_reduce", "reduce_scatter"])
     def test_reduce_matches_tree_psum(self, op):
@@ -170,6 +248,132 @@ def test_microbatch_grads_single_bucket_and_many():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_accum_step_reduce_scatter_pads_odd_shapes():
+    """Satellite pin: hidden=52 yields bias/logit leaves whose bucket
+    payloads don't divide the 8-way sync group — the in-scan
+    reduce_scatter padding path must still match the monolithic step."""
+    mesh = par.make_mesh()
+    state, batch = _mnist_setup(hidden=52)
+    mono = train.make_train_step(mesh=mesh, donate=False)
+    accum = train.make_accum_train_step(
+        mesh=mesh, microbatches=4, bucket_bytes=1024,
+        reduce_op="reduce_scatter", donate=False)
+    s1, m1 = mono(state, batch)
+    s2, m2 = accum(state, batch)
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-5
+    assert abs(float(m1["grad_norm"]) - float(m2["grad_norm"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_hierarchical_requires_multislice_mesh():
+    mesh = par.make_mesh()
+    state, batch = _mnist_setup()
+    step = train.make_accum_train_step(mesh=mesh, microbatches=4,
+                                       hierarchy="hierarchical",
+                                       donate=False)
+    with pytest.raises(ValueError, match="multi-slice"):
+        step(state, batch)
+    with pytest.raises(ValueError, match="hierarchy"):
+        train.make_accum_train_step(mesh=mesh, microbatches=4,
+                                    hierarchy="bogus",
+                                    donate=False)(state, batch)
+
+
+def _zero3_state(state, mesh):
+    """Shard the MLP state into the ZeRO-3 layout on ``mesh``."""
+    from tony_tpu.benchmark import fsdp_shard_state
+    return fsdp_shard_state(state, mesh)
+
+
+def test_zero3_accum_matches_replicated_and_monolithic():
+    """THE ZeRO-3 acceptance pin: fsdp-sharded params auto-detected, grads
+    psum_scatter-ed straight into the shard layout, loss/grad-norm/params
+    match both the replicated accum step and the monolithic step within
+    1e-5 — and the updated params STAY in the shard layout."""
+    mesh = par.make_mesh(fsdp=4)           # data=2 x fsdp=4
+    state, batch = _mnist_setup()
+    mono = train.make_train_step(mesh=mesh, donate=False)
+    s1, m1 = mono(state, batch)
+    repl = train.make_accum_train_step(mesh=mesh, microbatches=4,
+                                       bucket_bytes=32 * 1024,
+                                       donate=False)
+    s2, m2 = repl(state, batch)
+    zstate = _zero3_state(state, mesh)
+    zstep = train.make_accum_train_step(mesh=mesh, microbatches=4,
+                                        bucket_bytes=32 * 1024,
+                                        donate=False)
+    s3, m3 = zstep(zstate, batch)
+    for m in (m2, m3):
+        assert abs(float(m1["loss"]) - float(m["loss"])) < 1e-5
+        assert abs(float(m1["grad_norm"]) - float(m["grad_norm"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s3.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    # Sharding inspection: every updated leaf kept its fsdp placement
+    # (specs compared with trailing-None dims normalized away).
+    def norm(spec):
+        t = tuple(spec)
+        while t and t[-1] is None:
+            t = t[:-1]
+        return t
+
+    for old, new in zip(jax.tree.leaves(zstate.params),
+                        jax.tree.leaves(s3.params)):
+        assert norm(new.sharding.spec) == norm(old.sharding.spec)
+
+
+def test_zero3_grads_never_leave_shard_layout():
+    """Sharding inspection on the grads themselves: microbatch_grads with
+    param_specs returns grads carrying the fsdp spec (scatter path), and
+    the profiler records the scatter-bucket plan."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = par.make_mesh(fsdp=4)
+    state, batch = _mnist_setup()
+    zstate = _zero3_state(state, mesh)
+    specs = overlap.fsdp_param_specs(zstate.params, mesh)
+    assert specs is not None
+
+    def loss_fn(params, mb):
+        logits = zstate.apply_fn({"params": params}, mb["x"])
+        return train.cross_entropy_loss(logits, mb["y"])
+
+    profiler.reset_overlap_records()
+    with jax.sharding.Mesh(mesh.devices, mesh.axis_names):
+        loss, grads = jax.jit(lambda p, b: microbatch_grads(
+            loss_fn, p, b, mesh, microbatches=4, bucket_bytes=32 * 1024,
+            param_specs=specs))(zstate.params, batch)
+    spec_leaves = jax.tree.leaves(specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    sharded = 0
+    for g, spec in zip(jax.tree.leaves(grads), spec_leaves):
+        if any("fsdp" in str(e) for e in tuple(spec)):
+            assert "fsdp" in str(g.sharding.spec)
+            sharded += 1
+    assert sharded >= 4
+    rec = profiler.overlap_report()["accum_step"]
+    assert rec["zero3"] is True
+    assert rec["n_scatter_buckets"] >= 1
+    assert any(l["op"] == "psum_scatter" and l["axes"] == ["fsdp"]
+               for l in rec["levels"])
+
+
+def test_fsdp_param_specs_detection():
+    """Replicated params, fsdp=1 meshes, and non-array leaves all decline
+    detection; a llama state created on an fsdp mesh through the logical
+    rules opts in automatically."""
+    mesh_dp = par.make_mesh()
+    state, _ = _mnist_setup()
+    assert overlap.fsdp_param_specs(state.params, mesh_dp) is None
+    mesh_f = par.make_mesh(fsdp=4)
+    assert overlap.fsdp_param_specs(state.params, mesh_f) is None
+    assert overlap.fsdp_param_specs(
+        {"w": np.zeros((4, 4))}, mesh_f) is None
+    zstate = _zero3_state(state, mesh_f)
+    specs = overlap.fsdp_param_specs(zstate.params, mesh_f)
+    assert specs is not None
+
+
 def test_profiler_records_bucket_plan():
     profiler.reset_overlap_records()
     mesh = par.make_mesh()
@@ -191,6 +395,12 @@ class TestOverlapXlaFlags:
         for f in OVERLAP_XLA_FLAGS:
             assert f in out
 
+    def test_multislice_adds_dcn_set(self):
+        out = overlap_xla_flags(multislice=True)
+        for f in OVERLAP_XLA_FLAGS + MULTISLICE_XLA_FLAGS:
+            assert f in out
+        assert MULTISLICE_XLA_FLAGS[0] not in overlap_xla_flags()
+
     def test_user_flag_wins(self):
         user = "--xla_tpu_enable_latency_hiding_scheduler=false"
         out = overlap_xla_flags(user)
@@ -205,6 +415,25 @@ class TestOverlapXlaFlags:
     def test_idempotent(self):
         once = overlap_xla_flags()
         assert overlap_xla_flags(once) == once
+
+
+def test_record_failure_logs_debug_once(monkeypatch, caplog):
+    """Satellite pin: a broken profiler wiring must neither sink the step
+    nor stay silent — one DEBUG line on the first failure, then quiet."""
+    import logging
+
+    monkeypatch.setattr(overlap, "_record_failed", False)
+
+    def boom(*a, **kw):
+        raise RuntimeError("profiler wired wrong")
+
+    monkeypatch.setattr(profiler, "record_overlap", boom)
+    with caplog.at_level(logging.DEBUG, logger="tony_tpu.parallel.overlap"):
+        overlap._record("t1", n=1)      # must not raise
+        overlap._record("t2", n=2)
+    hits = [r for r in caplog.records if "profiler record" in r.message]
+    assert len(hits) == 1
+    assert hits[0].levelno == logging.DEBUG
 
 
 def test_train_step_seq_axis_keeps_ring_sharding():

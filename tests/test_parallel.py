@@ -33,7 +33,7 @@ def test_logical_sharding_rules():
     assert s.spec == jax.sharding.PartitionSpec("fsdp", "model")
     s2 = par.logical_sharding(mesh, "batch", "act_seq", "act_embed")
     assert s2.spec == jax.sharding.PartitionSpec(
-        ("data", "fsdp"), "seq", None)
+        ("slice", "data", "fsdp"), "seq", None)
 
 
 def test_shard_logical_places_array():

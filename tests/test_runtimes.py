@@ -213,6 +213,64 @@ def test_jax_rejects_ps():
         fw.am_adapter().validate_and_update_config(conf)
 
 
+def test_jax_multislice_megascale_env():
+    """tony.jax.slices>1 splits the rendezvous world into contiguous
+    equal slices and exports the megascale DCN coordination env: slice id
+    from global rank, coordinator on the rank-0 host, conf-keyed port."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 1,           # global rank 2 → slice 1
+                conf_extra={"tony.jax.slices": "2"}))
+    assert env[constants.ENV_MEGASCALE_NUM_SLICES] == "2"
+    assert env[constants.ENV_MEGASCALE_SLICE_ID] == "1"
+    assert env[constants.ENV_MEGASCALE_COORDINATOR_ADDRESS] == "h0:8537"
+    assert env[constants.ENV_MEGASCALE_PORT] == "8537"
+    # Slice 0 (global rank 0 = chief).
+    env0 = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "chief", 0, conf_extra={"tony.jax.slices": "2"}))
+    assert env0[constants.ENV_MEGASCALE_SLICE_ID] == "0"
+
+
+def test_jax_single_slice_no_megascale_env():
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0))
+    assert constants.ENV_MEGASCALE_NUM_SLICES not in env
+    assert constants.ENV_MEGASCALE_COORDINATOR_ADDRESS not in env
+
+
+def test_jax_multislice_adds_dcn_xla_flags():
+    """Multi-slice TPU tasks get the DCN overlap flag set on top of the
+    single-slice overlap knobs; single-slice tasks must not (fewer flags
+    = fewer compiler-version hazards)."""
+    multi = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0,
+                conf_extra={"tony.worker.tpus": "2",
+                            "tony.jax.slices": "2"}))
+    assert "--xla_tpu_data_parallel_opt_different_sized_ops=true" \
+        in multi[constants.ENV_XLA_FLAGS]
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" \
+        in multi[constants.ENV_XLA_FLAGS]
+    single = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0, conf_extra={"tony.worker.tpus": "2"}))
+    assert "--xla_tpu_data_parallel_opt_different_sized_ops" \
+        not in single[constants.ENV_XLA_FLAGS]
+
+
+def test_jax_slices_must_divide_world():
+    fw = get_framework("jax")
+    conf = TonyConfig({"tony.chief.instances": "1",
+                       "tony.worker.instances": "2",
+                       "tony.application.framework": "jax",
+                       "tony.jax.slices": "2"})
+    with pytest.raises(ValueError, match="slices"):
+        fw.am_adapter().validate_and_update_config(conf)
+    # Sidecars don't count toward the sliced world.
+    ok = TonyConfig({"tony.worker.instances": "4",
+                     "tony.tensorboard.instances": "1",
+                     "tony.application.framework": "jax",
+                     "tony.jax.slices": "2"})
+    fw.am_adapter().validate_and_update_config(ok)
+
+
 def test_mxnet_env():
     spec = {"scheduler": ["h0:9100"], "server": ["h0:9101"],
             "worker": ["h1:9102", "h1:9103"]}

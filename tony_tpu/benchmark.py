@@ -96,20 +96,51 @@ def resnet_window(batch: int, image: int, steps: int, *,
     return window, (params, opt_state, batch_stats)
 
 
+def fsdp_shard_state(state, mesh):
+    """Re-create a TrainState with params (and fresh optimizer state) in
+    the ZeRO-3 layout: each param's first fsdp-divisible dim is sharded
+    over the fsdp axis, the rest stay replicated — the manual analogue of
+    what ``create_train_state`` produces for models carrying "embed"
+    logical axes."""
+    from flax.training.train_state import TrainState
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    F = mesh.shape["fsdp"]
+
+    def spec_of(p):
+        for d, n in enumerate(p.shape):
+            if n % F == 0:
+                return P(*([None] * d + ["fsdp"]
+                           + [None] * (p.ndim - d - 1)))
+        return P()
+
+    shardings = jax.tree.map(
+        lambda p: NamedSharding(mesh, spec_of(p)), state.params)
+    params = jax.device_put(state.params, shardings)
+    return TrainState.create(apply_fn=state.apply_fn, params=params,
+                             tx=state.tx)
+
+
 def run_overlap_bench(*, batch: int | None = None, hidden: int = 512,
                       steps: int | None = None, microbatches: int = 4,
                       bucket_bytes: int = 1 << 20,
                       reduce_op: str = "all_reduce",
+                      slices: int = 1, fsdp: int = 1,
+                      zero3: bool = False, hierarchy: str = "auto",
                       on_tpu: bool | None = None) -> dict:
     """Overlap-engine leg: monolithic GSPMD step vs bucketed-accumulation
-    step (``make_accum_train_step``) on a pure-DP mesh over all local
-    devices, same model / optimizer / data.
+    step (``make_accum_train_step``) on a DP mesh over all local devices,
+    same model / optimizer / data.
 
-    Reports both step times, the speedup, the bucket plan (count and
-    per-bucket bytes — the numbers the latency-hiding scheduler pipelines),
-    and the numerics deltas between the two paths: the bucketed step must
-    match the monolithic step's loss and grad-norm within 1e-5 or the
-    comparison is void (``numerics_ok`` gates the headline).
+    ``slices=2`` builds a (host-simulated) multi-slice mesh and exercises
+    the hierarchical ICI/DCN reduce; ``zero3=True`` (with ``fsdp>1``)
+    shards the params so the accum step runs the psum_scatter-into-shard
+    path. Reports both step times, the speedup, the bucket plan (count and
+    per-bucket bytes — the numbers the latency-hiding scheduler pipelines,
+    plus the per-level plan for hierarchical/ZeRO-3 runs), and the
+    numerics deltas between the two paths: the bucketed step must match
+    the monolithic step's loss and grad-norm within 1e-5 or the comparison
+    is void (``numerics_ok`` gates the headline).
     """
     import optax
 
@@ -117,14 +148,14 @@ def run_overlap_bench(*, batch: int | None = None, hidden: int = 512,
     from tony_tpu import profiler
     from tony_tpu import train as tr
     from tony_tpu.models import get_model
-    from tony_tpu.parallel.overlap import GradBuckets
+    from tony_tpu.parallel import overlap
 
     if on_tpu is None:
         on_tpu = jax.default_backend() not in ("cpu",)
     if steps is None:
         steps = 20 if on_tpu else 4
-    mesh = par.make_mesh()          # every axis 1 except data: pure DP
-    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    mesh = par.make_mesh(slices=slices, fsdp=fsdp)   # rest of devices: data
+    dp = overlap.sync_size(mesh)
     if batch is None:
         batch = dp * microbatches * (16 if on_tpu else 4)
     model = get_model("mnist-mlp", hidden=hidden)
@@ -134,13 +165,21 @@ def run_overlap_bench(*, batch: int | None = None, hidden: int = 512,
     data = {"x": x, "y": y}
     state = tr.create_train_state(model, optax.sgd(0.1, momentum=0.9),
                                   x, kr)
-    plan = GradBuckets.plan(state.params, bucket_bytes)
+    if zero3:
+        if fsdp <= 1:
+            raise ValueError("zero3=True needs fsdp > 1")
+        state = fsdp_shard_state(state, mesh)
+        specs = overlap.fsdp_param_specs(state.params, mesh)
+        plan = overlap.GradBuckets.plan_sharded(
+            state.params, specs, shard_size=fsdp, bucket_bytes=bucket_bytes)
+    else:
+        plan = overlap.GradBuckets.plan(state.params, bucket_bytes)
 
     profiler.reset_overlap_records()
     mono = tr.make_train_step(mesh=mesh, donate=False)
     accum = tr.make_accum_train_step(
         mesh=mesh, microbatches=microbatches, bucket_bytes=bucket_bytes,
-        reduce_op=reduce_op, donate=False)
+        reduce_op=reduce_op, hierarchy=hierarchy, donate=False)
     # Numerics pin first, from the identical initial state.
     _, m_mono = mono(state, data)
     _, m_accum = accum(state, data)
@@ -160,6 +199,7 @@ def run_overlap_bench(*, batch: int | None = None, hidden: int = 512,
 
     mono_s = timed(mono)
     accum_s = timed(accum)
+    records = profiler.overlap_report()
     return {
         "metric": "overlap_bench",
         "mono_step_s": round(mono_s, 6),
@@ -167,16 +207,44 @@ def run_overlap_bench(*, batch: int | None = None, hidden: int = 512,
         "speedup": round(mono_s / accum_s, 4) if accum_s else None,
         "microbatches": microbatches,
         "reduce_op": reduce_op,
+        "slices": slices,
+        "fsdp": fsdp,
+        "zero3": zero3,
+        "hierarchy": records.get("accum_step", {}).get("hierarchy",
+                                                       hierarchy),
         "n_buckets": plan.n_buckets,
+        "n_scatter_buckets": plan.n_scatter_buckets,
         "bucket_nbytes": list(plan.bucket_nbytes),
         "bucket_threshold": plan.threshold,
         "loss_delta": loss_delta,
         "grad_norm_delta": gnorm_delta,
         "numerics_ok": bool(loss_delta < 1e-5 and gnorm_delta < 1e-5),
-        "overlap_records": profiler.overlap_report(),
+        "overlap_records": records,
         "batch": batch,
         "dp": dp,
         "backend": jax.default_backend(),
+    }
+
+
+def run_overlap_sweep(bucket_bytes_list=(64 << 10, 256 << 10, 1 << 20,
+                                         4 << 20),
+                      **kw) -> dict:
+    """Bucket-bytes sweep over :func:`run_overlap_bench` — the tuning
+    curve for the planner threshold (ROADMAP: record in BENCH). Returns
+    the per-threshold legs trimmed to the numbers that move."""
+    legs = []
+    for bb in bucket_bytes_list:
+        r = run_overlap_bench(bucket_bytes=bb, **kw)
+        legs.append({k: r[k] for k in (
+            "bucket_threshold", "n_buckets", "n_scatter_buckets",
+            "mono_step_s", "accum_step_s", "speedup", "numerics_ok")})
+    return {
+        "metric": "overlap_bucket_sweep",
+        "slices": kw.get("slices", 1),
+        "fsdp": kw.get("fsdp", 1),
+        "zero3": kw.get("zero3", False),
+        "backend": jax.default_backend(),
+        "legs": legs,
     }
 
 

@@ -76,6 +76,17 @@ LIBTPU_PORT_BASE = "tony.task.libtpu.port-base"
 # (tony.<jobtype>.tpus > 0 — the xla_tpu_* set aborts non-TPU XLA builds).
 # Explicit true/false forces it on (whole-host TPU jobs) / off.
 JAX_OVERLAP_XLA_FLAGS = "tony.jax.overlap-xla-flags"
+# Number of DCN-connected TPU slices the jax gang spans (>1 = multi-slice).
+# The rendezvous world is split contiguously into this many equal slices:
+# JAXRuntime derives each task's MEGASCALE_SLICE_ID from its global rank,
+# exports the megascale coordination env, and adds the DCN XLA flag set
+# (overlap.MULTISLICE_XLA_FLAGS) so the hierarchical per-bucket DCN
+# allreduces overlap. Must divide the rendezvous task count.
+JAX_SLICES = "tony.jax.slices"
+# Port for the megascale DCN transport/coordinator (same on every host;
+# conf-keyed like the libtpu base so concurrent jobs sharing hosts can be
+# kept apart). The coordinator is the global-rank-0 task's host.
+MEGASCALE_PORT = "tony.jax.megascale.port"
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

@@ -72,6 +72,13 @@ ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
 ENV_TPU_PROCESS_ADDRESSES = "TPU_PROCESS_ADDRESSES"
 ENV_TPU_PROCESS_PORT = "TPU_PROCESS_PORT"
 ENV_CLOUD_TPU_TASK_ID = "CLOUD_TPU_TASK_ID"
+# Multi-slice (megascale) DCN coordination: exported when tony.jax.slices>1
+# so libtpu bridges the slices over DCN and the hierarchical gradient
+# reduce (tony_tpu.parallel.overlap) has a cross-slice axis to ride.
+ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_MEGASCALE_PORT = "MEGASCALE_PORT"
 # XLA compiler knobs (JAXRuntime injects the comm/compute-overlap set —
 # latency-hiding scheduler + async collectives — unless disabled by conf)
 ENV_XLA_FLAGS = "XLA_FLAGS"

@@ -1112,7 +1112,9 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     from jax.sharding import PartitionSpec as P
 
     b, h = q.shape[0], q.shape[1]
-    dp_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    from tony_tpu.parallel.overlap import sync_axes  # call-time: no cycle
+
+    dp_axes = sync_axes(mesh)
     dp_size = 1
     for a in dp_axes:
         dp_size *= mesh.shape[a]

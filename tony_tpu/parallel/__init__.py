@@ -29,9 +29,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Mesh axis names, outermost (most DCN-friendly) to innermost (most
-# ICI-bandwidth-hungry). Data-parallel axes first so cross-slice traffic is
-# the cheap gradient allreduce; tensor-parallel innermost so its per-layer
-# collectives ride the fastest ICI links.
+# ICI-bandwidth-hungry). The slice axis IS the DCN boundary: collectives
+# over it cross slices, everything else stays on ICI. Data-parallel axes
+# next so cross-slice traffic is the cheap gradient allreduce;
+# tensor-parallel innermost so its per-layer collectives ride the fastest
+# ICI links.
+SLICE = "slice"     # DCN data parallel: one index per TPU slice
 DATA = "data"       # pure data parallel (replicated params)
 FSDP = "fsdp"       # data parallel with sharded params/optimizer (ZeRO-3)
 PIPE = "pipe"       # pipeline parallelism (GPipe over ppermute)
@@ -39,14 +42,14 @@ EXPERT = "expert"   # MoE expert parallelism
 SEQ = "seq"         # sequence/context parallelism (ring attention)
 MODEL = "model"     # tensor parallelism (megatron-style)
 
-AXES: Tuple[str, ...] = (DATA, FSDP, PIPE, EXPERT, SEQ, MODEL)
+AXES: Tuple[str, ...] = (SLICE, DATA, FSDP, PIPE, EXPERT, SEQ, MODEL)
 
 # Logical-axis → mesh-axis rules (flax linen logical partitioning format).
 # Parameters: weights shard over fsdp on their "embed"-like dim and over
 # model on their "heads/ffn/vocab"-like dim. Activations: batch over both
 # data axes, sequence over the ring axis.
 RULES: Tuple[Tuple[str, object], ...] = (
-    ("batch", (DATA, FSDP)),
+    ("batch", (SLICE, DATA, FSDP)),
     ("act_seq", SEQ),
     ("act_embed", None),   # activations' feature dim (params' "embed" is
                            # fsdp-sharded; mixing both in one array would
@@ -70,7 +73,9 @@ class MeshSpec:
 
     The product must equal the device count. ``dp`` is accumulated
     automatically when left at 0: remaining devices go to data parallelism —
-    the common "fill the pod with DP" default.
+    the common "fill the pod with DP" default. ``slices`` is the DCN-level
+    data-parallel degree (one index per TPU slice; 1 = single-slice job);
+    the overlap engine reduces over it separately from the ICI axes.
     """
     dp: int = 0
     fsdp: int = 1
@@ -78,20 +83,23 @@ class MeshSpec:
     ep: int = 1
     sp: int = 1
     tp: int = 1
+    slices: int = 1
 
     def resolved_dp(self, n_devices: int) -> int:
-        rest = self.fsdp * self.pp * self.ep * self.sp * self.tp
+        rest = (self.slices * self.fsdp * self.pp * self.ep * self.sp
+                * self.tp)
         if self.dp:
             return self.dp
         if n_devices % rest:
             raise ValueError(f"{n_devices} devices not divisible by "
-                             f"fsdp*pp*ep*sp*tp={rest}")
+                             f"slices*fsdp*pp*ep*sp*tp={rest}")
         return n_devices // rest
 
     def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
         devices = list(devices if devices is not None else jax.devices())
         dp = self.resolved_dp(len(devices))
-        shape = (dp, self.fsdp, self.pp, self.ep, self.sp, self.tp)
+        shape = (self.slices, dp, self.fsdp, self.pp, self.ep, self.sp,
+                 self.tp)
         if int(np.prod(shape)) != len(devices):
             raise ValueError(
                 f"mesh shape {dict(zip(AXES, shape))} needs "
@@ -110,11 +118,11 @@ def make_mesh(n_devices: Optional[int] = None, **spec_kw) -> Mesh:
 
 
 def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
-    """Input-batch sharding: batch dim over both DP axes; optionally the
-    sequence dim over the ring axis (long-context inputs)."""
+    """Input-batch sharding: batch dim over the slice axis and both DP axes;
+    optionally the sequence dim over the ring axis (long-context inputs)."""
     if seq_axis:
-        return NamedSharding(mesh, P((DATA, FSDP), SEQ))
-    return NamedSharding(mesh, P((DATA, FSDP)))
+        return NamedSharding(mesh, P((SLICE, DATA, FSDP), SEQ))
+    return NamedSharding(mesh, P((SLICE, DATA, FSDP)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -171,13 +179,15 @@ from tony_tpu.parallel.ring_attention import (  # noqa: E402  (re-export)
 from tony_tpu.parallel.pipeline import (  # noqa: E402  (re-export)
     gpipe, gpipe_1f1b, pipelined_lm_logits, stage_split)
 from tony_tpu.parallel.overlap import (  # noqa: E402  (re-export)
-    GradBuckets, microbatch_grads, overlap_xla_flags)
+    GradBuckets, fsdp_param_specs, microbatch_grads, overlap_xla_flags)
 
 __all__ = [
-    "AXES", "DATA", "FSDP", "PIPE", "EXPERT", "SEQ", "MODEL", "RULES",
+    "AXES", "SLICE", "DATA", "FSDP", "PIPE", "EXPERT", "SEQ", "MODEL",
+    "RULES",
     "MeshSpec", "make_mesh", "batch_sharding", "replicated",
     "logical_sharding", "shard_logical", "constraint",
     "ring_attention", "ring_attention_sharded", "gpipe", "gpipe_1f1b",
     "pipelined_lm_logits", "stage_split",
-    "GradBuckets", "microbatch_grads", "overlap_xla_flags",
+    "GradBuckets", "fsdp_param_specs", "microbatch_grads",
+    "overlap_xla_flags",
 ]

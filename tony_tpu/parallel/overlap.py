@@ -11,30 +11,34 @@ builds that layer natively:
   the flattened grad pytree. Each bucket concatenates same-dtype leaves up
   to ``bucket_bytes`` and is reduced as ONE collective, so small tensors
   amortize launch latency and big ones don't serialize the whole sync.
+  :meth:`GradBuckets.plan_sharded` is the ZeRO-3 planner: leaves with an
+  fsdp-sharded dim are packed *shard-major*, so one ``psum_scatter`` over
+  the fsdp axis lands each microbatch's grads straight in the shard layout
+  — no gather, no replicated-grad materialization.
 * :func:`microbatch_grads` — the accumulation step core: the local batch is
   split into K microbatches inside one ``lax.scan``; each microbatch's
-  grads are packed and reduced per bucket (``psum`` or
-  ``psum_scatter``+``all_gather``) *inside* the scan body, so under XLA's
-  latency-hiding scheduler the reduction of microbatch *i*'s buckets
-  overlaps the backward compute of microbatch *i+1*.
+  grads are packed and reduced per bucket *inside* the scan body, so under
+  XLA's latency-hiding scheduler the reduction of microbatch *i*'s buckets
+  overlaps the backward compute of microbatch *i+1*. On a multi-slice mesh
+  the reduce is two-level: ``psum_scatter`` intra-slice over ICI per
+  bucket, then a per-bucket allreduce over the DCN ``slice`` axis issued
+  inside the scan — the slow cross-slice hop rides under both the next
+  microbatch's backward and the next bucket's ICI phase.
   :func:`tony_tpu.train.make_accum_train_step` wraps this into a drop-in
-  train step.
+  train step and auto-detects the ZeRO-3 layout from the state's
+  shardings.
 * :func:`overlap_xla_flags` — the latency-hiding-scheduler / async
-  collective flags, merged into an ``XLA_FLAGS`` string with user-set
-  values winning; :class:`tony_tpu.runtime.jax_runtime.JAXTaskAdapter`
-  injects the result so tony-submitted jobs get the overlap for free.
-
-Scope: the engine treats the ``data`` and ``fsdp`` mesh axes as the
-gradient-sync group with params replicated inside the manually-sharded
-region (pure DP semantics — the layout ``batch_sharding`` feeds). Sharded-
-param (ZeRO-3) accumulation and cross-slice DCN bucketing are ROADMAP
-follow-ons built on this layer.
+  collective flags (plus the DCN set for multi-slice jobs), merged into an
+  ``XLA_FLAGS`` string with user-set values winning;
+  :class:`tony_tpu.runtime.jax_runtime.JAXTaskAdapter` injects the result
+  so tony-submitted jobs get the overlap for free.
 """
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +46,9 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tony_tpu import compat
-from tony_tpu.parallel import DATA, FSDP
+from tony_tpu.parallel import DATA, FSDP, SLICE
+
+_log = logging.getLogger(__name__)
 
 # Horovod's fusion buffer defaults to 64 MiB for NCCL rings; ICI collectives
 # saturate earlier, and smaller buckets mean the first reduction launches
@@ -64,35 +70,132 @@ OVERLAP_XLA_FLAGS: Tuple[str, ...] = (
     "--xla_tpu_overlap_compute_collective_tc=true",
 )
 
+# Multi-slice additions: let the scheduler split/overlap the DCN allreduces
+# that the hierarchical reduce issues per bucket (different-sized DCN ops
+# must not serialize behind each other). Same TPU-namespace-only rule.
+MULTISLICE_XLA_FLAGS: Tuple[str, ...] = (
+    "--xla_tpu_enable_data_parallel_all_reduce_opt=true",
+    "--xla_tpu_data_parallel_opt_different_sized_ops=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_reduce=true",
+)
+
 
 def _flag_name(flag: str) -> str:
     return flag.lstrip("-").split("=", 1)[0]
 
 
-def overlap_xla_flags(existing: str = "") -> str:
-    """Merge :data:`OVERLAP_XLA_FLAGS` into an ``XLA_FLAGS`` string.
+def overlap_xla_flags(existing: str = "", *, multislice: bool = False) -> str:
+    """Merge :data:`OVERLAP_XLA_FLAGS` (and, for multi-slice jobs,
+    :data:`MULTISLICE_XLA_FLAGS`) into an ``XLA_FLAGS`` string.
 
     A flag the caller already set (any value) is kept and ours dropped —
     injection must never override an operator's explicit tuning.
     """
+    ours = OVERLAP_XLA_FLAGS + (MULTISLICE_XLA_FLAGS if multislice else ())
     present = {_flag_name(f) for f in existing.split() if f.startswith("-")}
-    merged = [f for f in OVERLAP_XLA_FLAGS if _flag_name(f) not in present]
+    merged = [f for f in ours if _flag_name(f) not in present]
     return " ".join(filter(None, [existing.strip(), *merged])).strip()
 
 
 def sync_axes(mesh: Mesh) -> Tuple[str, ...]:
-    """The gradient-sync mesh axes: both DP axes, in mesh order — matches
-    :func:`tony_tpu.parallel.batch_sharding`'s batch placement."""
-    return tuple(a for a in (DATA, FSDP) if a in mesh.axis_names)
+    """The gradient-sync mesh axes: the DCN slice axis plus both DP axes,
+    in mesh order — matches :func:`tony_tpu.parallel.batch_sharding`'s
+    batch placement."""
+    return tuple(a for a in (SLICE, DATA, FSDP) if a in mesh.axis_names)
 
 
 def sync_size(mesh: Mesh) -> int:
-    """Device count of the gradient-sync group (product of the DP axes) —
-    the denominator shared by the accum step and the pipeline schedules."""
+    """Device count of the gradient-sync group (product of the slice and DP
+    axes) — the denominator shared by the accum step and the pipeline
+    schedules."""
     size = 1
     for a in sync_axes(mesh):
         size *= mesh.shape[a]
     return size
+
+
+def ici_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The intra-slice (ICI) gradient-sync axes: :func:`sync_axes` minus
+    the DCN slice axis."""
+    return tuple(a for a in (DATA, FSDP) if a in mesh.axis_names)
+
+
+def dcn_axis(mesh: Mesh) -> Optional[str]:
+    """The cross-slice (DCN) sync axis, or None on a single-slice mesh —
+    hierarchical reduction only exists when this is set."""
+    if SLICE in mesh.axis_names and mesh.shape[SLICE] > 1:
+        return SLICE
+    return None
+
+
+def fsdp_param_specs(params: Any, mesh: Mesh) -> Optional[Any]:
+    """Detect a ZeRO-3 (fsdp-sharded) parameter layout from the arrays'
+    committed shardings: a pytree of :class:`PartitionSpec` (one per leaf,
+    ``P()`` for replicated leaves) when at least one leaf is sharded over
+    the fsdp axis of a mesh with ``fsdp > 1``, else ``None``.
+
+    This is how ``train.make_accum_train_step`` decides between the
+    replicated-param and sharded-param accumulation paths without a flag:
+    the layout the state was created with IS the contract.
+    """
+    if FSDP not in mesh.axis_names or mesh.shape[FSDP] <= 1:
+        return None
+    leaves, treedef = jax.tree.flatten(params)
+    specs: List[P] = []
+    found = False
+    for leaf in leaves:
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is None:
+            spec = P()
+        # Strip size-1 mesh axes (a spec naming "model" on a model=1 mesh
+        # is replicated in fact): the engine plans off REAL sharding.
+        entries = []
+        for entry in tuple(spec):
+            names = entry if isinstance(entry, tuple) else (
+                (entry,) if entry is not None else ())
+            kept = tuple(a for a in names
+                         if a in mesh.axis_names and mesh.shape[a] > 1)
+            if FSDP in kept:
+                found = True
+            entries.append(kept if len(kept) > 1
+                           else (kept[0] if kept else None))
+        specs.append(P(*entries))
+    if not found:
+        return None
+    return jax.tree.unflatten(treedef, specs)
+
+
+def _shard_dim(spec: Any, shape: Tuple[int, ...], shard_axis: str,
+               shard_size: int) -> Optional[int]:
+    """The leaf dim sharded over ``shard_axis`` per ``spec`` (None when
+    replicated). Raises on layouts the accum engine cannot own: sharding
+    over any other mesh axis, fsdp combined with another axis on one dim,
+    or a sharded dim not divisible by the shard count."""
+    dim: Optional[int] = None
+    for d, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if shard_axis in names:
+            if len(names) > 1:
+                raise ValueError(
+                    f"param dim {d} sharded over {names}: the accum engine "
+                    f"supports {shard_axis!r} alone on a dim")
+            if dim is not None:
+                raise ValueError(
+                    f"param sharded over {shard_axis!r} on two dims "
+                    f"({dim} and {d}) — not a ZeRO-3 layout")
+            dim = d
+        else:
+            raise ValueError(
+                f"param dim {d} sharded over {names}: only {shard_axis!r} "
+                f"is supported inside the accum engine (model/pipe/seq "
+                f"axes belong to GSPMD, not the manual region)")
+    if dim is not None and shape[dim] % shard_size:
+        raise ValueError(
+            f"param shape {shape} not shardable: dim {dim} ({shape[dim]}) "
+            f"not divisible by {shard_axis}={shard_size}")
+    return dim
 
 
 @dataclass(frozen=True)
@@ -101,7 +204,15 @@ class GradBuckets:
     buckets: every leaf lands in exactly one bucket; leaves of one dtype
     pack together (a bucket is one concatenated 1-D buffer) in flatten
     order until adding the next leaf would cross ``threshold`` bytes; a
-    single leaf bigger than the threshold gets a bucket of its own."""
+    single leaf bigger than the threshold gets a bucket of its own.
+
+    A plan from :meth:`plan_sharded` additionally carries the ZeRO-3 shard
+    layout: ``shard_dims[i]`` is leaf *i*'s fsdp-sharded dim (None for
+    replicated leaves), and scatter buckets (``bucket_scatter``) hold only
+    sharded leaves, packed shard-major — chunk *f* of the buffer is the
+    concatenation of every member leaf's shard *f* — so ``psum_scatter``
+    over the fsdp axis yields exactly the local shard of the summed grads.
+    """
 
     treedef: Any
     shapes: Tuple[Tuple[int, ...], ...]
@@ -110,6 +221,9 @@ class GradBuckets:
     bucket_nbytes: Tuple[int, ...]         # payload bytes per bucket
     bucket_numel: Tuple[int, ...]          # payload elements per bucket
     threshold: int
+    shard_size: int = 1                    # fsdp axis size (1 = replicated)
+    shard_dims: Tuple[Optional[int], ...] = ()    # per-leaf sharded dim
+    bucket_scatter: Tuple[bool, ...] = ()         # per-bucket scatter flag
 
     @classmethod
     def plan(cls, tree: Any,
@@ -117,51 +231,123 @@ class GradBuckets:
         """Plan from any pytree of arrays / ShapeDtypeStructs / tracers
         (only ``.shape``/``.dtype`` are read — works under ``eval_shape``
         and inside a jit trace)."""
+        return cls._plan(tree, bucket_bytes, shard_dims=None, shard_size=1)
+
+    @classmethod
+    def plan_sharded(cls, tree: Any, specs: Any, *, shard_size: int,
+                     bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                     ) -> "GradBuckets":
+        """ZeRO-3 plan: ``specs`` is a pytree of :class:`PartitionSpec`
+        matching ``tree`` (``P()`` = replicated leaf); leaves with an
+        fsdp-sharded dim land in scatter buckets, the rest in ordinary
+        allreduce buckets. ``shard_size`` is the fsdp axis size."""
+        leaves = jax.tree.leaves(tree)
+        spec_leaves = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        if len(spec_leaves) != len(leaves):
+            raise ValueError(
+                f"param/spec trees disagree: {len(leaves)} leaves vs "
+                f"{len(spec_leaves)} specs")
+        shard_dims = tuple(
+            _shard_dim(s, tuple(l.shape), FSDP, shard_size)
+            for l, s in zip(leaves, spec_leaves))
+        return cls._plan(tree, bucket_bytes, shard_dims=shard_dims,
+                         shard_size=shard_size)
+
+    @classmethod
+    def _plan(cls, tree, bucket_bytes, *, shard_dims, shard_size):
         if bucket_bytes <= 0:
             raise ValueError(f"bucket_bytes must be positive, got "
                              f"{bucket_bytes}")
         leaves, treedef = jax.tree.flatten(tree)
+        if not leaves:
+            raise ValueError(
+                "GradBuckets.plan: empty gradient pytree — nothing to "
+                "bucket (did the loss close over its params instead of "
+                "taking them as an argument?)")
         shapes = tuple(tuple(l.shape) for l in leaves)
         dtypes = tuple(np.dtype(l.dtype) for l in leaves)
+        if shard_dims is None:
+            shard_dims = (None,) * len(leaves)
         sizes = [int(np.prod(s, dtype=np.int64)) * d.itemsize
                  for s, d in zip(shapes, dtypes)]
-        by_dtype: Dict[Any, list] = {}
+        # Group key: (dtype, scatterable) — a bucket is one collective, and
+        # a psum_scatter bucket cannot host replicated leaves (their grads
+        # must come back whole, not as a shard).
+        groups: Dict[Tuple[Any, bool], list] = {}
         for i, d in enumerate(dtypes):
-            by_dtype.setdefault(d, []).append(i)
-        buckets, nbytes, numel = [], [], []
+            sc = shard_dims[i] is not None and shard_size > 1
+            groups.setdefault((d, sc), []).append(i)
+        buckets, nbytes, numel, scatter = [], [], [], []
 
-        def close(cur, cur_b, d):
+        def close(cur, cur_b, d, sc):
             buckets.append(tuple(cur))
             nbytes.append(cur_b)
             numel.append(cur_b // d.itemsize)
+            scatter.append(sc)
 
-        for d, idxs in by_dtype.items():
+        for (d, sc), idxs in groups.items():
             cur: list = []
             cur_b = 0
             for i in idxs:
                 if cur and cur_b + sizes[i] > bucket_bytes:
-                    close(cur, cur_b, d)
+                    close(cur, cur_b, d, sc)
                     cur, cur_b = [], 0
                 cur.append(i)
                 cur_b += sizes[i]
             if cur:
-                close(cur, cur_b, d)
+                close(cur, cur_b, d, sc)
         return cls(treedef, shapes, dtypes, tuple(buckets), tuple(nbytes),
-                   tuple(numel), bucket_bytes)
+                   tuple(numel), bucket_bytes, shard_size, shard_dims,
+                   tuple(scatter))
 
     @property
     def n_buckets(self) -> int:
         return len(self.buckets)
 
+    @property
+    def n_scatter_buckets(self) -> int:
+        return sum(1 for s in self.bucket_scatter if s)
+
+    def _is_scatter(self, b: int) -> bool:
+        return bool(self.bucket_scatter) and self.bucket_scatter[b]
+
+    def shard_shape(self, i: int) -> Tuple[int, ...]:
+        """Leaf *i*'s local-shard shape under the plan's fsdp layout."""
+        d = self.shard_dims[i] if self.shard_dims else None
+        if d is None or self.shard_size == 1:
+            return self.shapes[i]
+        s = list(self.shapes[i])
+        s[d] //= self.shard_size
+        return tuple(s)
+
     def pack(self, tree: Any) -> list:
-        """Pytree → per-bucket 1-D concatenated buffers."""
+        """Pytree → per-bucket 1-D concatenated buffers. Scatter buckets
+        are packed shard-major (chunk f = every member leaf's shard f), so
+        a ``psum_scatter`` over the fsdp axis returns the local shard."""
         leaves = jax.tree.leaves(tree)
-        return [jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-                if len(idxs) > 1 else leaves[idxs[0]].reshape(-1)
-                for idxs in self.buckets]
+        out = []
+        for b, idxs in enumerate(self.buckets):
+            if self._is_scatter(b):
+                parts = []
+                for f in range(self.shard_size):
+                    for i in idxs:
+                        d = self.shard_dims[i]
+                        n = self.shapes[i][d] // self.shard_size
+                        parts.append(jax.lax.slice_in_dim(
+                            leaves[i], f * n, (f + 1) * n,
+                            axis=d).reshape(-1))
+                out.append(jnp.concatenate(parts))
+            elif len(idxs) > 1:
+                out.append(jnp.concatenate(
+                    [leaves[i].reshape(-1) for i in idxs]))
+            else:
+                out.append(leaves[idxs[0]].reshape(-1))
+        return out
 
     def unpack(self, bufs: Sequence[jax.Array]) -> Any:
-        """Per-bucket buffers → pytree (inverse of :meth:`pack`)."""
+        """Per-bucket FULL buffers → pytree (inverse of :meth:`pack` for
+        non-scatter plans / gathered buffers)."""
         leaves: list = [None] * len(self.shapes)
         for buf, idxs in zip(bufs, self.buckets):
             off = 0
@@ -169,6 +355,22 @@ class GradBuckets:
                 n = int(np.prod(self.shapes[i], dtype=np.int64))
                 leaves[i] = jax.lax.dynamic_slice_in_dim(
                     buf, off, n).reshape(self.shapes[i])
+                off += n
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    def unpack_shards(self, bufs: Sequence[jax.Array]) -> Any:
+        """Per-bucket buffers → pytree in the SHARD layout: scatter
+        buckets' buffers are the local ``psum_scatter`` chunk and unpack to
+        shard-shaped leaves; other buffers unpack whole."""
+        leaves: list = [None] * len(self.shapes)
+        for b, (buf, idxs) in enumerate(zip(bufs, self.buckets)):
+            off = 0
+            for i in idxs:
+                shp = self.shard_shape(i) if self._is_scatter(b) \
+                    else self.shapes[i]
+                n = int(np.prod(shp, dtype=np.int64))
+                leaves[i] = jax.lax.dynamic_slice_in_dim(
+                    buf, off, n).reshape(shp)
                 off += n
         return jax.tree.unflatten(self.treedef, leaves)
 
@@ -182,6 +384,12 @@ class GradBuckets:
         one tail ``all_gather`` — the bandwidth-optimal RS+AG split of an
         allreduce; ``group_size`` must be the product of the axis sizes.
         """
+        if self.n_scatter_buckets:
+            raise ValueError(
+                "reduce() is the replicated-plan primitive; ZeRO-3 "
+                "scatter plans are driven by microbatch_grads (shard-"
+                "major buffers unpack to the SHARD layout, not whole "
+                "leaves)")
         bufs = self.pack(tree)
         if op == "all_reduce":
             return self.unpack([jax.lax.psum(b, axis_names) for b in bufs])
@@ -200,14 +408,29 @@ class GradBuckets:
         return self.unpack(out)
 
 
+_record_failed = False
+
+
 def _record(tag: str, **fields) -> None:
     # Trace-time side channel into the profiler registry (lazy import:
     # parallel must stay importable without the profiler stack).
+    global _record_failed
     try:
         from tony_tpu import profiler
         profiler.record_overlap(tag, **fields)
     except Exception:   # noqa: BLE001 — bookkeeping must never sink a step
-        pass
+        if not _record_failed:
+            # Once at DEBUG (not per trace): a broken profiler wiring is
+            # diagnosable without a silent hole and without log spam.
+            _record_failed = True
+            _log.debug("overlap profiler record %r failed; further "
+                       "failures suppressed", tag, exc_info=True)
+
+
+def _present(mesh: Mesh, axes: Sequence[str]) -> Tuple[str, ...]:
+    """Drop size-1 axes: a psum over them is a no-op the latency-hiding
+    scheduler still has to place."""
+    return tuple(a for a in axes if mesh.shape[a] > 1)
 
 
 def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
@@ -215,17 +438,38 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                      buckets: Optional[GradBuckets] = None,
                      bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                      reduce_op: str = "all_reduce",
-                     has_aux: bool = False):
+                     has_aux: bool = False,
+                     param_specs: Optional[Any] = None,
+                     hierarchy: str = "auto"):
     """Gradient accumulation over ``microbatches`` with per-bucket sync.
 
     ``loss_fn(params, microbatch) -> loss`` (or ``(loss, aux)`` with
     ``has_aux``) is the per-shard loss — a *mean* over its microbatch
     slice, collective-free (the engine owns all cross-device traffic, like
-    ``gpipe``'s ``stage_fn`` contract). Params are replicated across the
-    sync axes inside the region; the batch's leading dim is split over
-    them. Returns ``(loss, grads)`` (or ``(loss, aux, grads)``): the
-    global-mean loss and grads, replicated — numerically the monolithic
-    full-batch step up to fp reassociation.
+    ``gpipe``'s ``stage_fn`` contract). The batch's leading dim is split
+    over the sync axes (slice × data × fsdp). Returns ``(loss, grads)``
+    (or ``(loss, aux, grads)``): the global-mean loss and grads —
+    numerically the monolithic full-batch step up to fp reassociation.
+
+    **Replicated mode** (``param_specs=None``): params are replicated
+    across the sync axes inside the region; grads come back replicated.
+
+    **ZeRO-3 mode** (``param_specs`` = pytree of ``PartitionSpec``): params
+    enter the region in their fsdp-shard layout; each microbatch gathers
+    them for compute, but the grads are ``psum_scatter``-ed straight into
+    the shard layout per shard-major bucket and NEVER materialize
+    replicated — the returned grads carry exactly ``param_specs``, ready
+    for ``apply_gradients`` on a sharded optimizer state.
+
+    **Hierarchy** (``"auto"`` | ``"flat"`` | ``"hierarchical"``): on a
+    multi-slice mesh (``slice`` axis > 1) the auto/hierarchical reduce is
+    two-level — ``psum_scatter`` over the intra-slice ICI axes per bucket,
+    then a small per-bucket allreduce over the DCN ``slice`` axis, both
+    issued inside the scan so the DCN hop hides under the next
+    microbatch's backward and the next bucket's ICI phase; the shards are
+    re-gathered over ICI once, after the scan. ``"flat"`` forces the
+    single-level reduce over the whole sync group (the numerics pin for
+    the hierarchical path).
 
     Inside the scan body each microbatch's grads are reduced bucket by
     bucket, so the collective for microbatch *i* is in flight while
@@ -235,57 +479,176 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     """
     axes = sync_axes(mesh)
     group = sync_size(mesh)
+    ici = ici_axes(mesh)
+    dcn = dcn_axis(mesh)
+    if hierarchy not in ("auto", "flat", "hierarchical"):
+        raise ValueError(f"unknown hierarchy {hierarchy!r} "
+                         "(auto|flat|hierarchical)")
+    if hierarchy == "hierarchical" and dcn is None:
+        raise ValueError(
+            "hierarchy='hierarchical' needs a multi-slice mesh (slice "
+            "axis > 1); build one with MeshSpec(slices=...)")
+    hier = dcn is not None and hierarchy != "flat"
+    ici_group = 1
+    for a in ici:
+        ici_group *= mesh.shape[a]
     lead = jax.tree.leaves(batch)[0].shape[0]
     if lead % (group * microbatches):
         raise ValueError(
             f"global batch {lead} not divisible by sync group {group} x "
             f"microbatches {microbatches} (= {group * microbatches})")
-    plan = buckets if buckets is not None else GradBuckets.plan(
-        params, bucket_bytes)
+
+    zero3 = param_specs is not None
+    if zero3:
+        fsdp_size = mesh.shape[FSDP] if FSDP in mesh.axis_names else 1
+        plan = buckets if buckets is not None else GradBuckets.plan_sharded(
+            params, param_specs, shard_size=fsdp_size,
+            bucket_bytes=bucket_bytes)
+        # Full-rank specs: shard_map wants one entry per dim.
+        spec_leaves = [
+            P(*(tuple(s) + (None,) * (len(shp) - len(tuple(s)))))
+            for s, shp in zip(
+                jax.tree.leaves(param_specs,
+                                is_leaf=lambda x: isinstance(x, P)),
+                plan.shapes)]
+        p_specs = jax.tree.unflatten(plan.treedef, spec_leaves)
+    else:
+        plan = buckets if buckets is not None else GradBuckets.plan(
+            params, bucket_bytes)
+        p_specs = jax.tree.map(lambda _: P(), params)
+    b_specs = jax.tree.map(lambda _: P(axes), batch)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+
+    # Per-bucket reduce schedule, resolved at trace time. Each bucket gets
+    # (mode, post_groups): mode fixes the in-scan collective + accumulator
+    # shape; post_groups are the psum axis groups issued after the scatter
+    # — hierarchical keeps the DCN hop its OWN collective so the scheduler
+    # can slide it independently of the ICI phase.
+    #   "scatter": psum_scatter over fsdp into the ZeRO-3 shard layout
+    #   "rs":      psum_scatter over the (padded) reduce group + tail AG
+    #   "ar":      plain psum
+    if reduce_op not in ("all_reduce", "reduce_scatter"):
+        raise ValueError(f"unknown reduce op {reduce_op!r} "
+                         "(all_reduce|reduce_scatter)")
+    sched = []
+    for b in range(plan.n_buckets):
+        if plan._is_scatter(b):
+            if hier:
+                post = [_present(mesh, tuple(a for a in ici if a != FSDP)),
+                        (dcn,)]
+            else:
+                post = [_present(mesh,
+                                 tuple(a for a in axes if a != FSDP))]
+            sched.append(("scatter", [g for g in post if g]))
+        elif hier:
+            sched.append(("rs", [(dcn,)]))
+        elif reduce_op == "reduce_scatter":
+            sched.append(("rs", []))
+        else:
+            sched.append(("ar", []))
+    rs_axes = ici if hier else axes          # psum_scatter group for "rs"
+    rs_group = ici_group if hier else group
+
+    levels: List[Dict[str, object]] = []
+    if zero3 and plan.n_scatter_buckets:
+        levels.append({
+            "level": "ici", "op": "psum_scatter", "axes": [FSDP],
+            "bucket_nbytes": [n if plan._is_scatter(b) else 0
+                              for b, n in enumerate(plan.bucket_nbytes)]})
+    # A flat reduce on a multi-slice mesh spans BOTH transports in one
+    # collective — label it so, or the report would claim the cross-slice
+    # hop rides ICI.
+    flat_level = "ici" if dcn is None or hier else "ici+dcn"
+    if any(m == "rs" for m, _ in sched):
+        levels.append({
+            "level": "ici" if hier else flat_level, "op": "psum_scatter",
+            "axes": list(rs_axes),
+            "bucket_nbytes": [n if m == "rs" else 0 for (m, _), n in
+                              zip(sched, plan.bucket_nbytes)]})
+    if any(m == "ar" for m, _ in sched):
+        levels.append({
+            "level": flat_level, "op": "all_reduce", "axes": list(axes),
+            "bucket_nbytes": [n if m == "ar" else 0 for (m, _), n in
+                              zip(sched, plan.bucket_nbytes)]})
+    if hier:
+        # The DCN hop moves one scattered chunk per bucket.
+        def _chunk(b):
+            numel, item = plan.bucket_numel[b], \
+                plan.dtypes[plan.buckets[b][0]].itemsize
+            if sched[b][0] == "scatter":
+                return (numel // plan.shard_size) * item
+            padded = numel + ((-numel) % rs_group)
+            return (padded // rs_group) * item
+        levels.append({
+            "level": "dcn", "op": "all_reduce", "axes": [dcn],
+            "bucket_nbytes": [_chunk(b) for b in range(plan.n_buckets)]})
     _record("accum_step", n_buckets=plan.n_buckets,
             bucket_nbytes=list(plan.bucket_nbytes),
             threshold=plan.threshold, microbatches=microbatches,
-            reduce_op=reduce_op, sync_group=group)
-    p_specs = jax.tree.map(lambda _: P(), params)
-    b_specs = jax.tree.map(lambda _: P(axes), batch)
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            reduce_op=reduce_op, sync_group=group,
+            hierarchy="hierarchical" if hier else "flat",
+            zero3=zero3, n_scatter_buckets=plan.n_scatter_buckets,
+            levels=levels)
+
+    def gather_params(p):
+        if not zero3:
+            return p
+        out = []
+        for i, leaf in enumerate(jax.tree.leaves(p)):
+            d = plan.shard_dims[i]
+            out.append(leaf if d is None else jax.lax.all_gather(
+                leaf, FSDP, axis=d, tiled=True))
+        return jax.tree.unflatten(plan.treedef, out)
 
     def spmd(params, local):
         mbs = jax.tree.map(
             lambda x: x.reshape((microbatches, x.shape[0] // microbatches)
                                 + x.shape[1:]), local)
         acc0 = []
-        for idxs, n in zip(plan.buckets, plan.bucket_numel):
+        for b, (idxs, n) in enumerate(zip(plan.buckets, plan.bucket_numel)):
             dt = plan.dtypes[idxs[0]]
-            if reduce_op == "reduce_scatter":
-                n = (n + ((-n) % group)) // group   # padded local shard
+            mode, _ = sched[b]
+            if mode == "scatter":
+                n = n // plan.shard_size
+            elif mode == "rs":
+                n = (n + ((-n) % rs_group)) // rs_group   # padded shard
             acc0.append(jnp.zeros((n,), dt))
 
         def body(carry, mb):
             loss_acc, aux_acc, acc = carry
-            out, grads = grad_fn(params, mb)
+            out, grads = grad_fn(gather_params(params), mb)
             loss, aux = out if has_aux else (out, jnp.float32(0.0))
             bufs = plan.pack(grads)
             nxt = []
-            for a, b in zip(acc, bufs):
-                if reduce_op == "reduce_scatter":
-                    pad = (-b.shape[0]) % group
+            for b, (a, buf) in enumerate(zip(acc, bufs)):
+                mode, post = sched[b]
+                if mode == "scatter":
+                    s = jax.lax.psum_scatter(buf, FSDP, tiled=True)
+                elif mode == "rs":
+                    pad = (-buf.shape[0]) % rs_group
                     if pad:
-                        b = jnp.concatenate(
-                            [b, jnp.zeros((pad,), b.dtype)])
-                    nxt.append(a + jax.lax.psum_scatter(b, axes,
-                                                        tiled=True))
+                        buf = jnp.concatenate(
+                            [buf, jnp.zeros((pad,), buf.dtype)])
+                    s = jax.lax.psum_scatter(buf, rs_axes, tiled=True)
                 else:
-                    nxt.append(a + jax.lax.psum(b, axes))
+                    s = jax.lax.psum(buf, axes)
+                for g in post:
+                    s = jax.lax.psum(s, g)
+                nxt.append(a + s)
             return (loss_acc + loss, aux_acc + aux, nxt), None
 
         (loss, aux, acc), _ = jax.lax.scan(
             body, (jnp.float32(0.0), jnp.float32(0.0), acc0), mbs)
-        if reduce_op == "reduce_scatter":
-            acc = [jax.lax.all_gather(a, axes, tiled=True)[:n]
-                   for a, n in zip(acc, plan.bucket_numel)]
+        # Tail: "rs" buckets re-gather ONCE over their scatter group;
+        # scatter buckets stay in the shard layout (that IS the output).
+        full = []
+        for b, (a, n) in enumerate(zip(acc, plan.bucket_numel)):
+            if sched[b][0] == "rs":
+                a = jax.lax.all_gather(a, rs_axes, tiled=True)[:n]
+            full.append(a)
         denom = microbatches * group
-        grads = jax.tree.map(lambda b: b / denom, plan.unpack(acc))
+        tree = plan.unpack_shards(full) if zero3 else plan.unpack(full)
+        grads = jax.tree.map(lambda b: b / denom, tree)
         loss = jax.lax.psum(loss, axes) / denom
         aux = jax.lax.psum(aux, axes) / denom
         return loss, aux, grads

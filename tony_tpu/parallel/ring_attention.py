@@ -115,7 +115,9 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     """Global-array entry point: shard_maps :func:`ring_attention` over the
     mesh. q/k/v are logically-global ``[batch, heads, seq, head_dim]``; the
     seq dim is sharded over ``seq_axis`` and heads over ``model_axis``."""
-    dp_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    from tony_tpu.parallel.overlap import sync_axes  # call-time: no cycle
+
+    dp_axes = sync_axes(mesh)
     tp = mesh.shape.get(model_axis, 1) if model_axis else 1
     if tp > 1 and k.shape[1] % tp:
         # GQA heads must divide the tensor-parallel axis to stay narrow;

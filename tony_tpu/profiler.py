@@ -43,20 +43,27 @@ _TRACE_OPTIONS = {
 # compile, not per step — so per-bucket collective sizes and schedule tick
 # counts are inspectable next to the xplane traces without parsing HLO.
 # Keyed by tag ("accum_step", "gpipe", "gpipe_1f1b"); last plan per tag
-# wins (a recompile IS a new plan).
+# wins (a recompile IS a new plan). Hierarchical/ZeRO-3 plans additionally
+# carry a ``levels`` list — one entry per reduction level ("ici"/"dcn")
+# with the collective op, its mesh axes, and the bytes each bucket moves
+# AT THAT LEVEL (the DCN entry shows the scattered-chunk sizes, i.e. what
+# actually crosses slices per bucket).
 OVERLAP_RECORDS: Dict[str, Dict[str, object]] = {}
 
 
 def record_overlap(tag: str, **fields) -> None:
     """Bank one overlap plan/schedule record (bucket count & bytes,
-    microbatches, reduce op, schedule tick count...)."""
+    microbatches, reduce op, per-level plans, schedule tick count...)."""
     OVERLAP_RECORDS[tag] = dict(fields)
 
 
 def overlap_report() -> Dict[str, Dict[str, object]]:
-    """Snapshot of every recorded overlap plan (deep-copied: callers
-    serialize this into bench/metrics JSON)."""
-    return {k: dict(v) for k, v in OVERLAP_RECORDS.items()}
+    """Snapshot of every recorded overlap plan (deep-copied — including
+    the nested per-level plans: callers serialize this into bench/metrics
+    JSON and must not alias the live registry)."""
+    import copy
+
+    return {k: copy.deepcopy(v) for k, v in OVERLAP_RECORDS.items()}
 
 
 def reset_overlap_records() -> None:

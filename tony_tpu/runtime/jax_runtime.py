@@ -133,15 +133,38 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
                 env[constants.ENV_TPU_PROCESS_ADDRESSES] = ",".join(addrs)
                 env[constants.ENV_TPU_PROCESS_PORT] = str(base + rank)
                 env[constants.ENV_CLOUD_TPU_TASK_ID] = str(rank)
+        # Multi-slice (tony.jax.slices > 1): the rendezvous world is split
+        # contiguously into equal slices; each task learns its slice id and
+        # the DCN coordinator so libtpu's megascale transport can bridge
+        # the slices. The hierarchical gradient reduce
+        # (tony_tpu.parallel.overlap, MeshSpec(slices=...)) rides the DCN
+        # axis this env materializes. The port is conf-fixed (same on
+        # every host, like the libtpu base): every slice must know the
+        # coordinator address BEFORE launch.
+        slices = ctx.conf.get_int(conf_mod.JAX_SLICES, 1)
+        if slices > 1:
+            if n % slices:
+                raise ValueError(
+                    f"tony.jax.slices={slices} does not divide the "
+                    f"{n}-task rendezvous world")
+            per_slice = n // slices
+            ms_port = ctx.conf.get_int(conf_mod.MEGASCALE_PORT, 8537)
+            host0 = coordinator.rsplit(":", 1)[0]
+            env[constants.ENV_MEGASCALE_COORDINATOR_ADDRESS] = \
+                f"{host0}:{ms_port}"
+            env[constants.ENV_MEGASCALE_NUM_SLICES] = str(slices)
+            env[constants.ENV_MEGASCALE_SLICE_ID] = str(rank // per_slice)
+            env[constants.ENV_MEGASCALE_PORT] = str(ms_port)
         # Comm/compute overlap (tony_tpu.parallel.overlap): inject the
         # latency-hiding-scheduler / async-collective XLA flags so
         # tony-submitted TPU jobs overlap gradient sync with backward
-        # compute by default. TPU-resourced tasks only unless forced by
-        # conf: XLA aborts on flags its build doesn't know, so the
-        # xla_tpu_* set would KILL a CPU-backend task at import. Merged
-        # UNDER any XLA_FLAGS from tony.<jobtype>.env (framework env wins
-        # the final build_task_env merge, so the merge happens here, with
-        # user flag names taking precedence).
+        # compute by default — plus the DCN set for multi-slice jobs, so
+        # the per-bucket cross-slice allreduces overlap too. TPU-resourced
+        # tasks only unless forced by conf: XLA aborts on flags its build
+        # doesn't know, so the xla_tpu_* set would KILL a CPU-backend task
+        # at import. Merged UNDER any XLA_FLAGS from tony.<jobtype>.env
+        # (framework env wins the final build_task_env merge, so the merge
+        # happens here, with user flag names taking precedence).
         overlap_set = ctx.conf.get(conf_mod.JAX_OVERLAP_XLA_FLAGS)
         inject = (ctx.conf.get_bool(conf_mod.JAX_OVERLAP_XLA_FLAGS)
                   if overlap_set is not None else tpus > 0)
@@ -149,7 +172,8 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
             from tony_tpu.parallel.overlap import overlap_xla_flags
             user_flags = ctx.conf.task_env(ctx.job_type).get(
                 constants.ENV_XLA_FLAGS, "")
-            env[constants.ENV_XLA_FLAGS] = overlap_xla_flags(user_flags)
+            env[constants.ENV_XLA_FLAGS] = overlap_xla_flags(
+                user_flags, multislice=slices > 1)
         # Profiler hook (SURVEY.md §5.1): tony_tpu.distributed.initialize
         # starts jax.profiler.start_server on this port in the user
         # process. The port is executor-reserved and EPHEMERAL (shipped to
@@ -187,6 +211,20 @@ class JAXAMAdapter(ApplicationMasterAdapter):
                 raise ValueError(
                     "framework=jax is SPMD: remove tony.ps.instances "
                     "(parameters are sharded with the model, not served)")
+        # Multi-slice needs equal contiguous slices of the rendezvous
+        # world — fail at submit, not at gang-up on the pod.
+        slices = conf.get_int(conf_mod.JAX_SLICES, 1)
+        if slices < 1:
+            raise ValueError(f"{conf_mod.JAX_SLICES} must be >= 1, got "
+                             f"{slices}")
+        if slices > 1:
+            world = sum(conf.instances(jt) for jt in conf.job_types()
+                        if jt not in constants.SIDECAR_JOB_TYPES)
+            if world % slices:
+                raise ValueError(
+                    f"{conf_mod.JAX_SLICES}={slices} does not divide the "
+                    f"{world}-task rendezvous world (slices must be "
+                    f"equal-sized)")
 
 
 class JAXFramework(Framework):

@@ -191,6 +191,7 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                           microbatches: int,
                           bucket_bytes: int = overlap.DEFAULT_BUCKET_BYTES,
                           reduce_op: str = "all_reduce",
+                          hierarchy: str = "auto",
                           donate: bool = True,
                           apply_kwargs_of: Optional[Callable[
                               [Dict[str, jax.Array]],
@@ -201,17 +202,26 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     Same ``(state, batch) -> (state, metrics)`` contract and numerics
     (loss/grads match the monolithic step to fp reassociation), but the
     local batch is split into ``microbatches`` inside one ``lax.scan`` and
-    the DP/FSDP gradient reduction is issued per size-targeted bucket as
-    each microbatch's backward finishes —
+    the gradient reduction is issued per size-targeted bucket as each
+    microbatch's backward finishes —
     :func:`tony_tpu.parallel.overlap.microbatch_grads` is the engine;
     :func:`~tony_tpu.parallel.overlap.overlap_xla_flags` supplies the XLA
     knobs that turn the structure into actual overlap on TPU.
 
-    Differences from the monolithic step: a mesh is required (the engine
-    owns the collectives); params must be replicated over the DP axes
-    (``batch_sharding`` layout — sharded-param accumulation is a ROADMAP
-    follow-on); the model must be collective-free inside (same contract
-    as ``gpipe``'s ``stage_fn``).
+    The parameter layout is detected from the state's committed shardings
+    per call (:func:`~tony_tpu.parallel.overlap.fsdp_param_specs`):
+
+    * replicated params → the pure-DP path (grads replicated);
+    * fsdp-sharded params (ZeRO-3, e.g. from ``create_train_state`` on an
+      ``fsdp > 1`` mesh) → grads are ``psum_scatter``-ed straight into the
+      shard layout and ``apply_gradients``/``global_norm`` run on sharded
+      grads — replicated gradients never materialize.
+
+    On a multi-slice mesh (``MeshSpec(slices=...)``) the reduce is
+    hierarchical by default: per-bucket ``psum_scatter`` over ICI, then a
+    per-bucket DCN allreduce inside the scan (``hierarchy="flat"`` forces
+    the single-level reduce — the numerics pin). The model must be
+    collective-free inside (same contract as ``gpipe``'s ``stage_fn``).
     """
     if mesh is None:
         raise ValueError("make_accum_train_step requires a mesh: the "
@@ -219,33 +229,50 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     if loss_of is None:
         loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
 
-    def step(state: TrainState, batch: Dict[str, jax.Array]):
-        def loss_fn(params, mb):
-            extra = apply_kwargs_of(mb) if apply_kwargs_of else {}
-            # No logical_axis_rules scope: inside the manually-sharded
-            # region GSPMD constraints don't apply (with no rules active,
-            # flax's with_logical_constraint is a no-op).
-            logits, sown = state.apply_fn(
-                {"params": params}, mb["x"], mutable="losses", **extra)
-            aux = sum((leaf.sum() for leaf in
-                       jax.tree.leaves(sown.get("losses", {}))),
-                      start=jnp.float32(0.0))
-            return loss_of(logits, mb) + aux, aux
+    def build(param_specs):
+        def step(state: TrainState, batch: Dict[str, jax.Array]):
+            def loss_fn(params, mb):
+                extra = apply_kwargs_of(mb) if apply_kwargs_of else {}
+                # No logical_axis_rules scope: inside the manually-sharded
+                # region GSPMD constraints don't apply (with no rules
+                # active, flax's with_logical_constraint is a no-op).
+                logits, sown = state.apply_fn(
+                    {"params": params}, mb["x"], mutable="losses", **extra)
+                aux = sum((leaf.sum() for leaf in
+                           jax.tree.leaves(sown.get("losses", {}))),
+                          start=jnp.float32(0.0))
+                return loss_of(logits, mb) + aux, aux
 
-        loss, aux, grads = overlap.microbatch_grads(
-            loss_fn, state.params, batch, mesh,
-            microbatches=microbatches, bucket_bytes=bucket_bytes,
-            reduce_op=reduce_op, has_aux=True)
-        new_state = state.apply_gradients(grads=grads)
-        gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm,
-                           "aux_loss": aux}
+            loss, aux, grads = overlap.microbatch_grads(
+                loss_fn, state.params, batch, mesh,
+                microbatches=microbatches, bucket_bytes=bucket_bytes,
+                reduce_op=reduce_op, has_aux=True,
+                param_specs=param_specs, hierarchy=hierarchy)
+            # ZeRO-3: grads carry the fsdp shard layout here, so the
+            # optimizer update and the norm reduction below run shard-
+            # local with GSPMD inserting only the tiny norm psum.
+            new_state = state.apply_gradients(grads=grads)
+            gnorm = optax.global_norm(grads)
+            return new_state, {"loss": loss, "grad_norm": gnorm,
+                               "aux_loss": aux}
 
-    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    # Layout detection memoized on the params' (treedef, shardings): one
+    # flatten + hash per step on the hit path — fsdp_param_specs' spec
+    # normalization and the jit-key build run only when the layout
+    # actually changes (in practice, once).
+    jitted: Dict[Any, Any] = {}
 
     def stepper(state, batch):
+        leaves, treedef = jax.tree.flatten(state.params)
+        key = (treedef,
+               tuple(getattr(l, "sharding", None) for l in leaves))
+        if key not in jitted:
+            jitted[key] = build(overlap.fsdp_param_specs(
+                state.params, mesh))
         with mesh_context(mesh):
-            return jitted(state, batch)
+            return jitted[key](state, batch)
     return stepper
 
 
