@@ -4,12 +4,14 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-slow quick test
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-slow quick test
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
-# (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh) so a
-# regression there fails the make target by name, not just as one more dot.
-tier1: tier1-verify tier1-multislice
+# (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh) and
+# the checkpoint leg (crash consistency / async overlap / elastic restore)
+# so a regression there fails the make target by name, not just as one
+# more dot.
+tier1: tier1-verify tier1-multislice tier1-ckpt
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -20,6 +22,12 @@ tier1-verify:
 # alone while iterating on the overlap engine).
 tier1-multislice:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m multislice -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Checkpoint-plane marker leg (fast, tmpdir-backed; also inside
+# tier1-verify's selection) — the slow large-state async-save test rides
+# tier1-slow instead.
+tier1-ckpt:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'ckpt and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

@@ -127,6 +127,24 @@ def main() -> int:
         except Exception as e:
             result["overlap_sweep_error"] = f"{type(e).__name__}: {e}"
             print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_CKPT", "1") != "0":
+        # Checkpoint-plane leg (tony_tpu.ckpt): blocking save wall time vs
+        # the stall an async save charges the train loop, plus the
+        # bit-exact restore pin. Runs on CPU too — unlike the overlap
+        # legs, the I/O-vs-compute overlap is real on any backend.
+        try:
+            from tony_tpu.benchmark import run_ckpt_bench
+            zero3_ckpt = 2 if n_dev % 2 == 0 else 1
+            ck = run_ckpt_bench(fsdp=zero3_ckpt)
+            result["ckpt_state_mb"] = ck["state_mb"]
+            result["ckpt_blocking_save_s"] = ck["blocking_save_s"]
+            result["ckpt_async_stall_s"] = ck["async_stall_s"]
+            result["ckpt_stall_vs_blocking"] = ck["stall_vs_blocking"]
+            result["ckpt_overlap_ok"] = ck["overlap_ok"]
+            result["ckpt_restore_exact"] = ck["restore_exact"]
+        except Exception as e:  # secondary metric must not sink the bench
+            result["ckpt_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

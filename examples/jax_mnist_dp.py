@@ -57,7 +57,8 @@ def main():
         mesh=mesh)
     # Checkpoint dir must be shared + stable across gang restarts (the
     # per-container sandbox is replaced on restart); every process calls
-    # save/restore — orbax coordinates the actual writes.
+    # save/restore — tony_tpu.ckpt coordinates the per-process shard
+    # writes through the shared directory (process 0 commits).
     ckpt_dir = os.environ.get("CKPT_DIR") or (
         Path.home() / ".tony-tpu" / "ckpt"
         / os.environ.get("TONY_APP_ID", "local-mnist"))

@@ -70,3 +70,41 @@ def test_restore_or_noop_without_checkpoint(tmp_path):
     ckpt = Checkpointer(tmp_path / "c")
     assert ckpt.restore_or(state) is state
     ckpt.close()
+
+
+def test_restore_or_shardingless_leaves_recover_mesh_layout(tmp_path):
+    """Satellite fix: the orbax shim built its abstract target with
+    ``sharding=getattr(x, "sharding", None)`` — a target leaf WITHOUT a
+    committed sharding (host numpy, e.g. a device_get'ed state) silently
+    restored replicated. The native restore maps the manifest's recorded
+    PartitionSpecs onto the mesh instead, so the layout survives."""
+    mesh = par.make_mesh(fsdp=2, tp=2, sp=2)
+    x = jnp.ones((4, 8))
+    state = tr.create_train_state(Tiny(), optax.adam(1e-2), x,
+                                  jax.random.PRNGKey(0), mesh=mesh)
+    ckpt = Checkpointer(tmp_path / "c")
+    ckpt.save(state)
+    host = jax.device_get(state)        # numpy leaves: no .sharding at all
+    restored = ckpt.restore_or(host, mesh=mesh)
+    kernel = restored.params["Dense_0"]["kernel"]
+    expect = state.params["Dense_0"]["kernel"]
+    assert kernel.sharding == expect.sharding      # NOT replicated
+    np.testing.assert_array_equal(np.asarray(kernel), np.asarray(expect))
+    ckpt.close()
+
+
+def test_save_async_then_restore_or_sees_it(tmp_path):
+    """wait-then-restore ordering: restore_or after an async (wait=False)
+    save must observe that save, not a stale latest."""
+    x = jnp.ones((2, 8))
+    state = tr.create_train_state(Tiny(), optax.sgd(0.1), x,
+                                  jax.random.PRNGKey(0))
+    state, _ = tr.make_train_step()(state, {"x": x,
+                                            "y": jnp.zeros((2,), jnp.int32)})
+    ckpt = Checkpointer(tmp_path / "c")
+    ckpt.save(state, wait=False)
+    restored = ckpt.restore_or(
+        tr.create_train_state(Tiny(), optax.sgd(0.1), x,
+                              jax.random.PRNGKey(1)))
+    assert int(restored.step) == 1
+    ckpt.close()

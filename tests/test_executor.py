@@ -84,3 +84,52 @@ def test_link_tree_localizes_by_hardlink(tmp_path):
     _link_tree(src, dest)
     assert (dest / "bin" / "python").read_text() == "#!/bin/sh\n"
     assert (dest / "lib.py").stat().st_ino == (src / "lib.py").stat().st_ino
+
+
+def test_heartbeat_reports_committed_ckpt_step(tmp_path):
+    """The executor half of the checkpoint control plane: with a
+    tony.ckpt.dir configured, the heartbeat loop scans the COMMITTED steps
+    (never the .tmp staging dirs) and piggybacks the newest on the RPC."""
+    import json
+
+    from tony_tpu import constants
+    from tony_tpu.conf import TonyConfig
+    from tony_tpu.executor import TaskExecutor
+    from tony_tpu.rpc import ApplicationRpcHandler, RpcServer
+    from tony_tpu.session import TonySession
+
+    ckpt_dir = tmp_path / "ckpt"
+    # A committed step and a torn staging dir (only the former may count).
+    committed = ckpt_dir / "step_00000005"
+    committed.mkdir(parents=True)
+    (committed / "manifest.json").write_text("{}")
+    (ckpt_dir / "step_00000006.tmp").mkdir()
+
+    conf = TonyConfig({"tony.worker.instances": "1",
+                       "tony.ckpt.dir": str(ckpt_dir)})
+    session = TonySession(conf, app_id="app_ckpt_hb")
+    session.on_registered("worker", 0, "127.0.0.1", 4000)
+    server = RpcServer(ApplicationRpcHandler(session),
+                       host="127.0.0.1").start()
+    conf_path = tmp_path / "conf.json"
+    conf_path.write_text(json.dumps(dict(conf.items())))
+    try:
+        executor = TaskExecutor(env={
+            constants.ENV_JOB_NAME: "worker",
+            constants.ENV_TASK_INDEX: "0",
+            constants.ENV_AM_ADDRESS: server.address,
+            constants.ENV_CONF_PATH: str(conf_path),
+        })
+        t = threading.Thread(target=executor._heartbeat_loop,
+                             args=(0.05,), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and session.task("worker", 0).ckpt_step != 5:
+            time.sleep(0.05)
+        executor._hb_stop.set()
+        t.join(timeout=5)
+        assert session.task("worker", 0).ckpt_step == 5
+        assert session.last_committed_step() == 5
+    finally:
+        server.stop()

@@ -71,6 +71,31 @@ def test_result_heartbeat_metrics_and_status(server_and_session):
         assert status["status"] == "SUCCEEDED"
 
 
+def test_heartbeat_carries_committed_ckpt_step(server_and_session):
+    """Checkpoint-plane wiring: an executor that sees a tony.ckpt.dir
+    piggybacks the last committed step; older executors omit the param and
+    nothing changes (the optional-kwarg back-compat contract)."""
+    server, handler, session = server_and_session
+    with RpcClient(server.address, timeout=5) as c:
+        c.call("register_worker_spec", job_type="worker", index=0,
+               host="h", port=1)
+        c.call("register_worker_spec", job_type="worker", index=1,
+               host="h", port=2)
+        assert session.last_committed_step() is None
+        c.call("heartbeat", job_type="worker", index=0)       # legacy form
+        assert session.last_committed_step() is None
+        c.call("heartbeat", job_type="worker", index=0, ckpt_step=7)
+        c.call("heartbeat", job_type="worker", index=1, ckpt_step=6)
+        assert session.task("worker", 0).ckpt_step == 7
+        assert session.last_committed_step() == 7
+        # Surfaced to the client through get_task_infos.
+        infos = {i["index"]: i for i in c.call("get_task_infos")}
+        assert infos[0]["ckpt_step"] == 7 and infos[1]["ckpt_step"] == 6
+        # A later heartbeat WITHOUT the param must not erase progress.
+        c.call("heartbeat", job_type="worker", index=0)
+        assert session.last_committed_step() == 7
+
+
 def test_error_transport(server_and_session):
     server, _, _ = server_and_session
     with RpcClient(server.address, timeout=5) as c:

@@ -198,6 +198,34 @@ def test_jax_overlap_flags_conf_gated_off():
     assert constants.ENV_XLA_FLAGS not in env
 
 
+def test_jax_ckpt_env_exported_from_conf():
+    """tony.ckpt.dir/every/keep reach the user process as TONY_CKPT_* —
+    train_loop's defaults — with every/keep defaulted when unset; no
+    ckpt env at all when the dir isn't configured."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0,
+                conf_extra={"tony.ckpt.dir": "/mnt/durable/ckpt",
+                            "tony.ckpt.every": "50"}))
+    assert env[constants.ENV_CKPT_DIR] == "/mnt/durable/ckpt"
+    assert env[constants.ENV_CKPT_EVERY] == "50"
+    assert env[constants.ENV_CKPT_KEEP] == "3"
+    bare = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0))
+    assert constants.ENV_CKPT_DIR not in bare
+
+
+def test_jax_ckpt_env_not_exported_to_sidecars():
+    """Sidecars are outside the SPMD world: they must not inherit the
+    checkpoint wiring (a tensorboard task scanning/saving into the train
+    job's directory would be wrong in both directions)."""
+    spec = dict(SPEC, tensorboard=["h9:5000"])
+    env = get_framework("jax").task_adapter().framework_env(
+        ctx_for("jax", "tensorboard", 0, spec=spec,
+                conf_extra={"tony.tensorboard.instances": "1",
+                            "tony.ckpt.dir": "/mnt/durable/ckpt"}))
+    assert constants.ENV_CKPT_DIR not in env
+
+
 def test_jax_sidecar_gets_no_overlap_flags():
     spec = dict(SPEC, tensorboard=["h9:5000"])
     env = get_framework("jax").task_adapter().build_task_env(

@@ -32,7 +32,7 @@ tony-history-server (Play portal)           tony_tpu.history
 tony-proxy ProxyServer                      tony_tpu.proxy
 tony-mini (docker pseudo-cluster)           tony_tpu.minipod (in-process)
 (delegated to ML frameworks in reference)   tony_tpu.models / ops / parallel / train
-(user-side in reference)                    tony_tpu.distributed / checkpoint
+(user-side in reference)                    tony_tpu.distributed / ckpt
 ==========================================  =========================================
 """
 

@@ -460,8 +460,14 @@ class ApplicationMaster:
                     self.events.task_finished(
                         t.job_type, t.index, t.status.value, t.exit_code,
                         t.diagnostics, t.metrics)
+        # Checkpoint plane: what the executors reported committed this
+        # attempt (heartbeat piggyback) — the step the NEXT attempt's
+        # restore_on_start will resume from after a gang restart.
+        ckpt_step = session.last_committed_step()
         self._log(f"attempt {attempt_id}: {session.job_status.value} "
-                  f"- {session.final_message}")
+                  f"- {session.final_message}"
+                  + (f" (last committed ckpt step: {ckpt_step})"
+                     if ckpt_step is not None else ""))
         return session.job_status
 
     # -- whole application -------------------------------------------------
@@ -484,8 +490,14 @@ class ApplicationMaster:
                 if status in (JobStatus.SUCCEEDED, JobStatus.KILLED):
                     break
                 if attempt <= retries:
-                    self._log(f"attempt {attempt} failed; gang restart "
-                              f"({attempt}/{retries} retries used)")
+                    ckpt_step = (self.session.last_committed_step()
+                                 if self.session else None)
+                    self._log(
+                        f"attempt {attempt} failed; gang restart "
+                        f"({attempt}/{retries} retries used)"
+                        + (f"; resuming from committed ckpt step "
+                           f"{ckpt_step}" if ckpt_step is not None
+                           else ""))
         finally:
             self.final_status = status
             self.final_message = (self.session.final_message

@@ -248,6 +248,116 @@ def run_overlap_sweep(bucket_bytes_list=(64 << 10, 256 << 10, 1 << 20,
     }
 
 
+def run_ckpt_bench(*, hidden: int = 2048, steps: int = 4, saves: int = 3,
+                   fsdp: int = 1, directory: str | None = None) -> dict:
+    """Checkpoint-plane leg: blocking save wall time vs the stall an async
+    save actually charges the train loop (slot wait + device→host extract;
+    the serialize/fsync/commit overlaps subsequent steps on the writer
+    thread). Same state, same directory tree, best-of-``saves`` each.
+
+    ``fsdp > 1`` shards the state first so the saves exercise the shard-
+    local write path (each process writes only its replica-0 chunks).
+    The restore leg re-reads the last committed step and pins it bit-exact
+    against the live state — a save that stalls less but restores wrong
+    is not a checkpoint. ``overlap_ok`` (async stall < blocking save)
+    gates the headline, mirroring ``numerics_ok`` in the overlap bench.
+    """
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+    import optax
+
+    from tony_tpu import ckpt as ckpt_mod
+    from tony_tpu import parallel as par
+    from tony_tpu import profiler
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+
+    mesh = par.make_mesh(fsdp=fsdp)
+    dp = 1
+    for a in mesh.axis_names:
+        dp *= mesh.shape[a]
+    batch = dp * 4
+    model = get_model("mnist-mlp", hidden=hidden)
+    kx, ky, kr = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(kx, (batch, 784), jnp.float32)
+    y = jax.random.randint(ky, (batch,), 0, 10)
+    data = {"x": x, "y": y}
+    state = tr.create_train_state(model, optax.sgd(0.1, momentum=0.9),
+                                  x, kr)
+    if fsdp > 1:
+        state = fsdp_shard_state(state, mesh)
+    step = tr.make_train_step(mesh=mesh, donate=False)
+    state, _ = step(state, data)            # warm the compile
+    root = Path(directory) if directory else Path(tempfile.mkdtemp(
+        prefix="tony-ckpt-bench-"))
+    profiler.reset_ckpt_records()
+    try:
+        blocking = ckpt_mod.AsyncCheckpointer(root / "blocking", keep=2)
+        blocking_s = []
+        for i in range(saves):
+            t0 = time.perf_counter()
+            blocking.save(state, step=i + 1, block=True)
+            blocking_s.append(time.perf_counter() - t0)
+        blocking.close()
+        profiler.record_ckpt("blocking_save", save_s=min(blocking_s),
+                             nbytes=blocking.stats["nbytes"])
+
+        async_c = ckpt_mod.AsyncCheckpointer(root / "async", keep=2)
+        overlap_step_s = []
+        for i in range(saves):
+            async_c.save(state, step=i + 1)      # stall recorded inside
+            t0 = time.perf_counter()             # steps riding the write
+            for _ in range(steps):
+                state, _ = step(state, data)
+            jax.block_until_ready(state.params)
+            overlap_step_s.append((time.perf_counter() - t0) / steps)
+        async_c.wait()
+        stall_s = min(async_c.stats["stall_s"])
+        write_s = min(async_c.stats["write_s"])
+        nbytes = async_c.stats["nbytes"]
+
+        # Restore pin: save the CURRENT state once more (the earlier async
+        # saves snapshotted older states) and require the committed step
+        # to round-trip bit-exact through the elastic path (mesh-mapped
+        # specs, no target shardings) — a save that stalls less but
+        # restores wrong is not a checkpoint.
+        async_c.save(state, step=saves + 1, block=True)
+        abstract = jax.tree.map(
+            lambda a: np.zeros(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, jax.device_get(state))
+        restored = ckpt_mod.restore_pytree(root / "async", abstract,
+                                           mesh=mesh)
+        exact = all(
+            np.array_equal(np.asarray(jax.device_get(a)),
+                           np.asarray(jax.device_get(b)))
+            for a, b in zip(jax.tree.leaves(restored),
+                            jax.tree.leaves(state))
+            if hasattr(b, "shape"))
+        async_c.close()
+    finally:
+        if not directory:
+            shutil.rmtree(root, ignore_errors=True)
+    return {
+        "metric": "ckpt_bench",
+        "state_mb": round(nbytes / (1024 * 1024), 3),
+        "blocking_save_s": round(min(blocking_s), 6),
+        "async_stall_s": round(stall_s, 6),
+        "async_write_s": round(write_s, 6),
+        "stall_vs_blocking": round(stall_s / min(blocking_s), 4)
+        if min(blocking_s) else None,
+        "overlap_ok": bool(stall_s < min(blocking_s)),
+        "restore_exact": bool(exact),
+        "overlapped_step_s": round(min(overlap_step_s), 6),
+        "saves": saves,
+        "fsdp": fsdp,
+        "ckpt_records": profiler.ckpt_report(),
+        "backend": jax.default_backend(),
+    }
+
+
 def peak_flops(on_tpu: bool | None = None) -> float:
     """THE peak-FLOPs rule for MFU accounting (single definition — every
     bench leg divides by this): the chip generation's bf16 peak on TPU, a

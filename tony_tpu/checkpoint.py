@@ -1,61 +1,59 @@
-"""Checkpoint/resume helper: the idiomatic orbax wrapper (SURVEY.md §5.4).
+"""Checkpoint/resume helper — thin compat shim over :mod:`tony_tpu.ckpt`.
 
 The reference delegates checkpointing entirely to user code (HDFS dirs that
 survive AM restarts; TonY just restarts the gang and the script restores).
-The TPU rebuild keeps that contract — the AM checkpoints nothing — but ships
-this helper so JAXRuntime jobs resume by default across gang restarts
-(``tony.am.retry-count``): sharded arrays save/restore with their mesh
-layouts intact, every process participates (orbax coordinates the writes),
-and ``restore_or`` is a no-op on the first attempt.
+This class keeps the seed-era surface (``save`` / ``restore_or`` /
+``latest_step`` / ``close``) so existing user scripts resume across gang
+restarts (``tony.am.retry-count``) unchanged — but it now rides the native
+async subsystem instead of orbax (no longer required): crash-consistent
+manifest commits, sharded per-process writes, elastic restore.
+
+Fixed here vs the orbax shim: ``restore_or`` used to build its abstract
+target with ``sharding=getattr(x, "sharding", None)`` — a leaf WITHOUT a
+committed sharding (host numpy arrays, freshly-created states) silently
+restored replicated even when the checkpoint recorded a mesh layout. The
+native restore resolves each leaf's layout from the target's committed
+sharding when present and from the manifest's PartitionSpec otherwise, so
+shardings survive either way.
 """
 
 from __future__ import annotations
 
-import os
 from pathlib import Path
 from typing import Any, Optional
 
-import jax
+from tony_tpu import ckpt as _ckpt
 
 
 class Checkpointer:
-    """Thin orbax CheckpointManager wrapper bound to one directory."""
+    """Directory-bound save/restore manager (seed-compatible surface)."""
 
     def __init__(self, directory: str | Path, max_to_keep: int = 3):
-        import orbax.checkpoint as ocp
-        self._ocp = ocp
         self.directory = Path(directory).resolve()
-        self.mgr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True))
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mgr = _ckpt.AsyncCheckpointer(self.directory, keep=max_to_keep)
 
     def save(self, state: Any, step: Optional[int] = None,
              wait: bool = True) -> None:
-        """Save a pytree (e.g. a TrainState); all processes must call."""
-        if step is None:
-            step = int(jax.device_get(state.step)) if hasattr(state, "step") \
-                else 0
-        self.mgr.save(step, args=self._ocp.args.StandardSave(state))
-        if wait:
-            self.mgr.wait_until_finished()
+        """Save a pytree (e.g. a TrainState); all processes must call.
+        ``wait=False`` returns after the device→host snapshot and commits
+        in the background (:class:`tony_tpu.ckpt.AsyncCheckpointer`)."""
+        self._mgr.save(state, step=step, block=wait)
 
     def latest_step(self) -> Optional[int]:
-        return self.mgr.latest_step()
+        return _ckpt.latest_step(self.directory)
 
-    def restore_or(self, state: Any) -> Any:
+    def restore_or(self, state: Any, mesh: Any = None) -> Any:
         """Restore the latest checkpoint shaped/sharded like ``state``, or
-        return ``state`` unchanged when none exists (first attempt)."""
-        latest = self.mgr.latest_step()
-        if latest is None:
-            return state
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
-            if hasattr(x, "shape") else x,
-            state)
-        return self.mgr.restore(
-            latest, args=self._ocp.args.StandardRestore(abstract))
+        return ``state`` unchanged when none exists (first attempt).
+        ``mesh`` enables elastic restore onto a topology other than the
+        one the state's own shardings (if any) describe."""
+        # Drain in-flight async saves first: "latest" must mean latest.
+        self._mgr.wait()
+        return _ckpt.restore_latest(self.directory, state, mesh=mesh)
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait()
 
     def close(self) -> None:
-        self.mgr.close()
+        self._mgr.close()

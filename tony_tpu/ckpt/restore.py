@@ -1,0 +1,193 @@
+"""Elastic restore: rebuild a pytree from a committed checkpoint, onto a
+possibly DIFFERENT topology than the one that wrote it.
+
+The degraded-topology resume the multi-slice work needs (a ZeRO-3 state
+written on an ``S×fsdp`` mesh restored onto fewer slices or a different
+fsdp degree) falls out of the format: the manifest records every leaf's
+global shape + PartitionSpec and every chunk's global extent, so restore is
+pure geometry —
+
+1. resolve each leaf's TARGET sharding: the target tree's own committed
+   sharding when it has one, else the manifest's PartitionSpec mapped onto
+   the new mesh (axes the new mesh lacks — or whose new size no longer
+   divides the dim — degrade to replicated for that dim), else host numpy;
+2. for every local device shard the target sharding asks for, assemble its
+   slice of the global array from the covering file chunks (seek-read only
+   what overlaps — a 1-slice restore of a 2-slice checkpoint reads each
+   byte once, not the whole payload per device);
+3. ``jax.make_array_from_single_device_arrays`` stitches the per-device
+   buffers into the global array — multi-host safe, no cross-process
+   traffic (every process reads only its own shards from the shared dir).
+
+Leaves absent from the manifest (``apply_fn``-style statics) pass through
+from the target; dtype changes cast; shape changes raise.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.ckpt import format as fmt
+from tony_tpu.ckpt.snapshot import _is_saveable, leaf_paths
+
+
+def adapt_spec(spec: Optional[P], shape: tuple, mesh: Mesh) -> P:
+    """Map a manifest PartitionSpec onto a (possibly different) mesh: keep
+    each dim's axes only when the new mesh has them ALL and their combined
+    size still divides the dim — otherwise that dim degrades to replicated
+    (correct, just less sharded; the resharding IS the elasticity)."""
+    if spec is None:
+        return P()
+    entries = []
+    for d, entry in enumerate(tuple(spec)):
+        names = entry if isinstance(entry, tuple) else (
+            (entry,) if entry is not None else ())
+        size = 1
+        ok = bool(names)
+        for a in names:
+            if a not in mesh.axis_names:
+                ok = False
+                break
+            size *= mesh.shape[a]
+        if not ok or d >= len(shape) or size == 0 or shape[d] % size:
+            entries.append(None)
+        else:
+            entries.append(entry)
+    return P(*entries)
+
+
+def _assemble(reader: fmt.ChunkReader, leaf_idx: int, dtype: np.dtype,
+              index: tuple, global_shape: tuple,
+              chunk_cache: Optional[Dict[Any, np.ndarray]] = None
+              ) -> np.ndarray:
+    """Build the sub-array ``global[index]`` from the covering chunks.
+    ``chunk_cache`` (keyed by file+offset, scoped to one leaf) avoids
+    re-reading/re-verifying a chunk that covers several target shards."""
+    start = [int(s.start or 0) for s in index]
+    stop = [int(s.stop if s.stop is not None else n)
+            for s, n in zip(index, global_shape)]
+    out_shape = [b - a for a, b in zip(start, stop)]
+    out = np.empty(out_shape, dtype=dtype)
+    filled = 0
+    for chunk in reader.chunks_for_leaf(leaf_idx):
+        c_start = chunk["start"]
+        c_stop = [a + s for a, s in zip(c_start, chunk["shape"])]
+        lo = [max(a, b) for a, b in zip(start, c_start)]
+        hi = [min(a, b) for a, b in zip(stop, c_stop)]
+        if any(a >= b for a, b in zip(lo, hi)):
+            continue
+        key = (chunk["file"], chunk["offset"])
+        data = chunk_cache.get(key) if chunk_cache is not None else None
+        if data is None:
+            data = reader.read(chunk, dtype)
+            if chunk_cache is not None:
+                chunk_cache[key] = data
+        src = tuple(slice(a - cs, b - cs)
+                    for a, b, cs in zip(lo, hi, c_start))
+        dst = tuple(slice(a - os_, b - os_)
+                    for a, b, os_ in zip(lo, hi, start))
+        out[dst] = data[src]
+        filled += int(np.prod([b - a for a, b in zip(lo, hi)],
+                              dtype=np.int64))
+    if filled != out.size:
+        raise IOError(
+            f"checkpoint leaf {leaf_idx}: chunks cover {filled} of "
+            f"{out.size} elements for shard {index} — incomplete payload "
+            f"(replica-0 chunks must partition every leaf)")
+    return out
+
+
+def _restore_leaf(reader: fmt.ChunkReader, leaf_idx: int,
+                  meta: Dict[str, Any], target: Any,
+                  mesh: Optional[Mesh]) -> Any:
+    global_shape = tuple(meta["shape"])
+    saved_dtype = fmt.dtype_from_name(meta["dtype"])
+    t_shape = tuple(np.shape(target)) if not isinstance(
+        target, (bool, int, float, complex)) else ()
+    if hasattr(target, "shape") and t_shape != global_shape:
+        raise ValueError(
+            f"checkpoint leaf {meta['path']}: saved shape "
+            f"{global_shape} != target shape {t_shape} — the checkpoint "
+            f"was written for a different model")
+    dtype = np.dtype(getattr(target, "dtype", saved_dtype))
+    if hasattr(dtype, "name"):
+        dtype = fmt.dtype_from_name(dtype.name)   # normalize ml_dtypes
+
+    sharding = getattr(target, "sharding", None)
+    if sharding is None and mesh is not None:
+        sharding = NamedSharding(
+            mesh, adapt_spec(fmt.spec_from_json(meta["spec"]),
+                             global_shape, mesh))
+    if sharding is None:
+        full = _assemble(reader, leaf_idx, saved_dtype,
+                         tuple(slice(0, n) for n in global_shape),
+                         global_shape)
+        return full.astype(dtype, copy=False)
+
+    # Device path: one host assembly per DISTINCT shard extent (chunks
+    # read/verified once even when they span extents), then a device_put
+    # per local device; the global array is stitched without any
+    # cross-process traffic.
+    index_map = sharding.devices_indices_map(global_shape)
+    cache: Dict[Any, np.ndarray] = {}
+    chunk_cache: Dict[Any, np.ndarray] = {}
+    arrays = []
+    for device in sharding.addressable_devices:
+        index = index_map[device]
+        key = tuple((s.start, s.stop) for s in index)
+        buf = cache.get(key)
+        if buf is None:
+            buf = _assemble(reader, leaf_idx, saved_dtype, index,
+                            global_shape,
+                            chunk_cache).astype(dtype, copy=False)
+            cache[key] = buf
+        arrays.append(jax.device_put(buf, device))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrays)
+
+
+def restore_pytree(root: str | Path, target: Any, *,
+                   step: Optional[int] = None, mesh: Optional[Mesh] = None,
+                   verify: bool = True, strict: bool = True) -> Any:
+    """Restore ``target``'s array leaves from the committed checkpoint at
+    ``step`` (default: newest). ``target`` supplies structure, statics,
+    dtypes, and — when its leaves carry committed shardings — the exact
+    output layout; ``mesh`` supplies the layout for shardingless targets
+    (manifest specs mapped through :func:`adapt_spec`). ``strict`` raises
+    when an array leaf has no manifest entry (else it passes through)."""
+    if step is None:
+        step = fmt.latest_step(root)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {root}")
+    manifest = fmt.read_manifest(root, step)
+    by_path = {m["path"]: (i, m) for i, m in enumerate(manifest["leaves"])}
+    paths, leaves, treedef = leaf_paths(target)
+    out = []
+    with fmt.ChunkReader(root, step, manifest, verify=verify) as reader:
+        for path, leaf in zip(paths, leaves):
+            if path not in by_path:
+                if strict and _is_saveable(leaf) and np.ndim(leaf) > 0:
+                    raise KeyError(
+                        f"target leaf {path} has no entry in checkpoint "
+                        f"step {step} (pass strict=False to keep the "
+                        f"target's value)")
+                out.append(leaf)
+                continue
+            idx, meta = by_path[path]
+            out.append(_restore_leaf(reader, idx, meta, leaf, mesh))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def restore_latest(root: str | Path, target: Any, *,
+                   mesh: Optional[Mesh] = None, verify: bool = True) -> Any:
+    """``restore_pytree`` when a committed step exists, else ``target``
+    unchanged — the first-attempt no-op the gang-restart contract needs."""
+    if fmt.latest_step(root) is None:
+        return target
+    return restore_pytree(root, target, mesh=mesh, verify=verify)

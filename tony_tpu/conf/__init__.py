@@ -87,6 +87,15 @@ JAX_SLICES = "tony.jax.slices"
 # conf-keyed like the libtpu base so concurrent jobs sharing hosts can be
 # kept apart). The coordinator is the global-rank-0 task's host.
 MEGASCALE_PORT = "tony.jax.megascale.port"
+# Checkpoint plane (tony_tpu.ckpt). tony.ckpt.dir names the DURABLE shared
+# directory (the HDFS-dir analogue that survives gang restarts) the async
+# checkpointer commits steps into; setting it turns on the whole wiring:
+# JAXRuntime exports TONY_CKPT_DIR/EVERY/KEEP to jax tasks (train_loop's
+# defaults), and the executor reports the last committed step found there
+# over the heartbeat RPC so the AM logs what a gang restart resumes from.
+CKPT_DIR = "tony.ckpt.dir"
+CKPT_EVERY = "tony.ckpt.every"            # save every N steps (0 = final only)
+CKPT_KEEP = "tony.ckpt.keep"              # committed steps retained (def. 3)
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

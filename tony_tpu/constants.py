@@ -37,6 +37,13 @@ ENV_PROCESS_ID = "TONY_PROCESS_ID"
 ENV_NUM_PROCESSES = "TONY_NUM_PROCESSES"
 ENV_LOCAL_DEVICE_IDS = "TONY_LOCAL_DEVICE_IDS"
 ENV_PROFILER_PORT = "TONY_PROFILER_PORT"    # jax.profiler server (§5.1 hook)
+# Checkpoint plane (tony_tpu.ckpt): JAXRuntime exports these from
+# tony.ckpt.dir/every/keep; train.train_loop reads them as its defaults,
+# and the executor scans the same dir to report the last COMMITTED step
+# over the heartbeat RPC.
+ENV_CKPT_DIR = "TONY_CKPT_DIR"
+ENV_CKPT_EVERY = "TONY_CKPT_EVERY"
+ENV_CKPT_KEEP = "TONY_CKPT_KEEP"
 
 # TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
 ENV_TF_CONFIG = "TF_CONFIG"

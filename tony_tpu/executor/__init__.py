@@ -295,15 +295,38 @@ class TaskExecutor:
 
         Uses its own short-timeout RPC client: the shared ``self.client``
         retries transport errors internally for its full 30s window, which
-        would stretch ``max_failures`` consecutive misses into minutes."""
+        would stretch ``max_failures`` consecutive misses into minutes.
+
+        When the job configures ``tony.ckpt.dir``, each heartbeat also
+        carries the last COMMITTED checkpoint step found there (a cheap
+        committed-dir scan — the manifest rename is the commit point, so
+        listing is race-free): the AM logs per attempt what a gang restart
+        will resume from. The scan must never sink liveness — any failure
+        degrades to reporting nothing."""
         hb_client = RpcClient(self.am_address, token=self.token,
                               timeout=max(1.0, interval_s))
+        ckpt_dir = self.conf.get(conf_mod.CKPT_DIR) or None
+
+        def ckpt_step() -> Optional[int]:
+            if not ckpt_dir:
+                return None
+            try:
+                # format, not the package: the package import pulls the
+                # snapshot/restore stack (jax) the executor doesn't need.
+                from tony_tpu.ckpt.format import latest_step
+                return latest_step(ckpt_dir)
+            except Exception:   # noqa: BLE001 — advisory telemetry only
+                return None
+
         failures = 0
         try:
             while not self._hb_stop.wait(interval_s):
                 try:
+                    step = ckpt_step()
                     hb_client.call("heartbeat", job_type=self.job_type,
-                                   index=self.index)
+                                   index=self.index,
+                                   **({"ckpt_step": step}
+                                      if step is not None else {}))
                     failures = 0
                     if self._am_lost and self.user_proc is None:
                         # The AM was only transiently unreachable (e.g. a

@@ -169,8 +169,9 @@ def _shard_dim(spec: Any, shape: Tuple[int, ...], shard_axis: str,
                shard_size: int) -> Optional[int]:
     """The leaf dim sharded over ``shard_axis`` per ``spec`` (None when
     replicated). Raises on layouts the accum engine cannot own: sharding
-    over any other mesh axis, fsdp combined with another axis on one dim,
-    or a sharded dim not divisible by the shard count."""
+    over any other mesh axis, or fsdp combined with another axis on one
+    dim. A sharded dim NOT divisible by the shard count is legal — the
+    planner pads it into its scatter bucket (see ``shard_pads``)."""
     dim: Optional[int] = None
     for d, entry in enumerate(tuple(spec)):
         if entry is None:
@@ -191,10 +192,6 @@ def _shard_dim(spec: Any, shape: Tuple[int, ...], shard_axis: str,
                 f"param dim {d} sharded over {names}: only {shard_axis!r} "
                 f"is supported inside the accum engine (model/pipe/seq "
                 f"axes belong to GSPMD, not the manual region)")
-    if dim is not None and shape[dim] % shard_size:
-        raise ValueError(
-            f"param shape {shape} not shardable: dim {dim} ({shape[dim]}) "
-            f"not divisible by {shard_axis}={shard_size}")
     return dim
 
 
@@ -212,6 +209,13 @@ class GradBuckets:
     sharded leaves, packed shard-major — chunk *f* of the buffer is the
     concatenation of every member leaf's shard *f* — so ``psum_scatter``
     over the fsdp axis yields exactly the local shard of the summed grads.
+
+    Leaves whose sharded dim does NOT divide the fsdp axis (the uneven
+    ZeRO-3 follow-on) are padded into dedicated scatter buckets
+    (``shard_pads[i]`` rows of zeros on the shard dim, ``bucket_padded``
+    marks the buckets): the in-scan ``psum_scatter`` is identical, and the
+    consumer re-gathers + unpads them after the scan (their grads come
+    back whole — the uneven leaf can't live in the shard layout).
     """
 
     treedef: Any
@@ -224,6 +228,8 @@ class GradBuckets:
     shard_size: int = 1                    # fsdp axis size (1 = replicated)
     shard_dims: Tuple[Optional[int], ...] = ()    # per-leaf sharded dim
     bucket_scatter: Tuple[bool, ...] = ()         # per-bucket scatter flag
+    shard_pads: Tuple[int, ...] = ()       # per-leaf pad rows on shard dim
+    bucket_padded: Tuple[bool, ...] = ()   # per-bucket uneven-leaf flag
 
     @classmethod
     def plan(cls, tree: Any,
@@ -239,8 +245,9 @@ class GradBuckets:
                      ) -> "GradBuckets":
         """ZeRO-3 plan: ``specs`` is a pytree of :class:`PartitionSpec`
         matching ``tree`` (``P()`` = replicated leaf); leaves with an
-        fsdp-sharded dim land in scatter buckets, the rest in ordinary
-        allreduce buckets. ``shard_size`` is the fsdp axis size."""
+        fsdp-sharded dim land in scatter buckets (uneven dims padded into
+        their own buckets), the rest in ordinary allreduce buckets.
+        ``shard_size`` is the fsdp axis size."""
         leaves = jax.tree.leaves(tree)
         spec_leaves = jax.tree.leaves(
             specs, is_leaf=lambda x: isinstance(x, P))
@@ -269,37 +276,51 @@ class GradBuckets:
         dtypes = tuple(np.dtype(l.dtype) for l in leaves)
         if shard_dims is None:
             shard_dims = (None,) * len(leaves)
-        sizes = [int(np.prod(s, dtype=np.int64)) * d.itemsize
-                 for s, d in zip(shapes, dtypes)]
-        # Group key: (dtype, scatterable) — a bucket is one collective, and
-        # a psum_scatter bucket cannot host replicated leaves (their grads
-        # must come back whole, not as a shard).
-        groups: Dict[Tuple[Any, bool], list] = {}
+        pads = tuple(
+            (-shapes[i][d]) % shard_size if (d := shard_dims[i]) is not None
+            and shard_size > 1 else 0
+            for i in range(len(leaves)))
+        # Payload size: scatter leaves count their PADDED extent — the pad
+        # rows ride the collective, so the planner must budget them.
+        sizes = []
+        for i, (s, d) in enumerate(zip(shapes, dtypes)):
+            numel = int(np.prod(s, dtype=np.int64))
+            if pads[i] and s[shard_dims[i]]:
+                numel = numel // s[shard_dims[i]] * (s[shard_dims[i]]
+                                                    + pads[i])
+            sizes.append(numel * d.itemsize)
+        # Group key: (dtype, scatterable, padded) — a bucket is one
+        # collective; a psum_scatter bucket cannot host replicated leaves
+        # (their grads must come back whole, not as a shard), and padded
+        # (uneven) leaves get their own buckets because theirs are
+        # re-gathered after the scan while even leaves stay sharded.
+        groups: Dict[Tuple[Any, bool, bool], list] = {}
         for i, d in enumerate(dtypes):
             sc = shard_dims[i] is not None and shard_size > 1
-            groups.setdefault((d, sc), []).append(i)
-        buckets, nbytes, numel, scatter = [], [], [], []
+            groups.setdefault((d, sc, sc and pads[i] > 0), []).append(i)
+        buckets, nbytes, numel, scatter, padded = [], [], [], [], []
 
-        def close(cur, cur_b, d, sc):
+        def close(cur, cur_b, d, sc, pd):
             buckets.append(tuple(cur))
             nbytes.append(cur_b)
             numel.append(cur_b // d.itemsize)
             scatter.append(sc)
+            padded.append(pd)
 
-        for (d, sc), idxs in groups.items():
+        for (d, sc, pd), idxs in groups.items():
             cur: list = []
             cur_b = 0
             for i in idxs:
                 if cur and cur_b + sizes[i] > bucket_bytes:
-                    close(cur, cur_b, d, sc)
+                    close(cur, cur_b, d, sc, pd)
                     cur, cur_b = [], 0
                 cur.append(i)
                 cur_b += sizes[i]
             if cur:
-                close(cur, cur_b, d, sc)
+                close(cur, cur_b, d, sc, pd)
         return cls(treedef, shapes, dtypes, tuple(buckets), tuple(nbytes),
                    tuple(numel), bucket_bytes, shard_size, shard_dims,
-                   tuple(scatter))
+                   tuple(scatter), pads, tuple(padded))
 
     @property
     def n_buckets(self) -> int:
@@ -312,30 +333,57 @@ class GradBuckets:
     def _is_scatter(self, b: int) -> bool:
         return bool(self.bucket_scatter) and self.bucket_scatter[b]
 
+    def _is_padded(self, b: int) -> bool:
+        return bool(self.bucket_padded) and self.bucket_padded[b]
+
+    def _pad(self, i: int) -> int:
+        return self.shard_pads[i] if self.shard_pads else 0
+
+    def padded_shape(self, i: int) -> Tuple[int, ...]:
+        """Leaf *i*'s shape with the uneven-shard pad applied."""
+        pad = self._pad(i)
+        if not pad:
+            return self.shapes[i]
+        s = list(self.shapes[i])
+        s[self.shard_dims[i]] += pad
+        return tuple(s)
+
     def shard_shape(self, i: int) -> Tuple[int, ...]:
-        """Leaf *i*'s local-shard shape under the plan's fsdp layout."""
+        """Leaf *i*'s local-shard shape under the plan's fsdp layout
+        (padded extent for uneven leaves — their shard IS padded)."""
         d = self.shard_dims[i] if self.shard_dims else None
         if d is None or self.shard_size == 1:
             return self.shapes[i]
-        s = list(self.shapes[i])
+        s = list(self.padded_shape(i))
         s[d] //= self.shard_size
         return tuple(s)
 
     def pack(self, tree: Any) -> list:
         """Pytree → per-bucket 1-D concatenated buffers. Scatter buckets
         are packed shard-major (chunk f = every member leaf's shard f), so
-        a ``psum_scatter`` over the fsdp axis returns the local shard."""
+        a ``psum_scatter`` over the fsdp axis returns the local shard;
+        uneven leaves are zero-padded on the shard dim first."""
         leaves = jax.tree.leaves(tree)
         out = []
         for b, idxs in enumerate(self.buckets):
             if self._is_scatter(b):
+                src = {}
+                for i in idxs:
+                    pad = self._pad(i)
+                    if pad:
+                        d = self.shard_dims[i]
+                        widths = [(0, pad if k == d else 0)
+                                  for k in range(len(self.shapes[i]))]
+                        src[i] = jnp.pad(leaves[i], widths)
+                    else:
+                        src[i] = leaves[i]
                 parts = []
                 for f in range(self.shard_size):
                     for i in idxs:
                         d = self.shard_dims[i]
-                        n = self.shapes[i][d] // self.shard_size
+                        n = self.padded_shape(i)[d] // self.shard_size
                         parts.append(jax.lax.slice_in_dim(
-                            leaves[i], f * n, (f + 1) * n,
+                            src[i], f * n, (f + 1) * n,
                             axis=d).reshape(-1))
                 out.append(jnp.concatenate(parts))
             elif len(idxs) > 1:
@@ -345,17 +393,54 @@ class GradBuckets:
                 out.append(leaves[idxs[0]].reshape(-1))
         return out
 
+    def leaf_buffers(self, b: int, buf: jax.Array, *,
+                     layout: str) -> Dict[int, jax.Array]:
+        """Bucket *b*'s buffer → ``{leaf_index: array}``.
+
+        ``layout="full"``: linear packing of whole leaves (allreduce / re-
+        gathered rs buckets). ``layout="shard"``: a scatter bucket's local
+        ``psum_scatter`` chunk → shard-shaped leaves. ``layout="gathered"``:
+        a scatter bucket's buffer re-gathered over the fsdp axis (shard-
+        major, padded) → whole UNPADDED leaves — the uneven-leaf exit path.
+        """
+        idxs = self.buckets[b]
+        out: Dict[int, jax.Array] = {}
+        if layout == "gathered":
+            chunk = self.bucket_numel[b] // self.shard_size
+            off = 0
+            for i in idxs:
+                shp = self.shard_shape(i)
+                n = int(np.prod(shp, dtype=np.int64))
+                d = self.shard_dims[i]
+                full = jnp.concatenate(
+                    [jax.lax.dynamic_slice_in_dim(
+                        buf, f * chunk + off, n).reshape(shp)
+                     for f in range(self.shard_size)], axis=d)
+                if self._pad(i):
+                    full = jax.lax.slice_in_dim(
+                        full, 0, self.shapes[i][d], axis=d)
+                out[i] = full
+                off += n
+            return out
+        if layout not in ("full", "shard"):
+            raise ValueError(f"unknown layout {layout!r}")
+        off = 0
+        for i in idxs:
+            shp = self.shard_shape(i) if layout == "shard" \
+                else self.shapes[i]
+            n = int(np.prod(shp, dtype=np.int64))
+            out[i] = jax.lax.dynamic_slice_in_dim(
+                buf, off, n).reshape(shp)
+            off += n
+        return out
+
     def unpack(self, bufs: Sequence[jax.Array]) -> Any:
         """Per-bucket FULL buffers → pytree (inverse of :meth:`pack` for
         non-scatter plans / gathered buffers)."""
         leaves: list = [None] * len(self.shapes)
-        for buf, idxs in zip(bufs, self.buckets):
-            off = 0
-            for i in idxs:
-                n = int(np.prod(self.shapes[i], dtype=np.int64))
-                leaves[i] = jax.lax.dynamic_slice_in_dim(
-                    buf, off, n).reshape(self.shapes[i])
-                off += n
+        for b in range(len(self.buckets)):
+            for i, v in self.leaf_buffers(b, bufs[b], layout="full").items():
+                leaves[i] = v
         return jax.tree.unflatten(self.treedef, leaves)
 
     def unpack_shards(self, bufs: Sequence[jax.Array]) -> Any:
@@ -363,15 +448,11 @@ class GradBuckets:
         buckets' buffers are the local ``psum_scatter`` chunk and unpack to
         shard-shaped leaves; other buffers unpack whole."""
         leaves: list = [None] * len(self.shapes)
-        for b, (buf, idxs) in enumerate(zip(bufs, self.buckets)):
-            off = 0
-            for i in idxs:
-                shp = self.shard_shape(i) if self._is_scatter(b) \
-                    else self.shapes[i]
-                n = int(np.prod(shp, dtype=np.int64))
-                leaves[i] = jax.lax.dynamic_slice_in_dim(
-                    buf, off, n).reshape(shp)
-                off += n
+        for b in range(len(self.buckets)):
+            layout = "shard" if self._is_scatter(b) else "full"
+            for i, v in self.leaf_buffers(b, bufs[b],
+                                          layout=layout).items():
+                leaves[i] = v
         return jax.tree.unflatten(self.treedef, leaves)
 
     def reduce(self, tree: Any, axis_names: Tuple[str, ...], *,
@@ -457,9 +538,16 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     **ZeRO-3 mode** (``param_specs`` = pytree of ``PartitionSpec``): params
     enter the region in their fsdp-shard layout; each microbatch gathers
     them for compute, but the grads are ``psum_scatter``-ed straight into
-    the shard layout per shard-major bucket and NEVER materialize
+    the shard layout per shard-major bucket and never materialize
     replicated — the returned grads carry exactly ``param_specs``, ready
-    for ``apply_gradients`` on a sharded optimizer state.
+    for ``apply_gradients`` on a sharded optimizer state. EXCEPTION —
+    uneven leaves (sharded dim not divisible by the fsdp size, which used
+    to raise): their reduction still rides a zero-padded scatter bucket,
+    but the leaf itself crosses the region boundary REPLICATED (shard_map
+    cannot split an indivisible dim) and its grad comes back whole, so
+    the per-leaf memory saving does not apply to it. Logged (WARNING,
+    once per plan) so a large uneven leaf — e.g. a vocab embedding whose
+    dim doesn't divide fsdp — can't silently eat the ZeRO-3 budget.
 
     **Hierarchy** (``"auto"`` | ``"flat"`` | ``"hierarchical"``): on a
     multi-slice mesh (``slice`` axis > 1) the auto/hierarchical reduce is
@@ -504,14 +592,32 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         plan = buckets if buckets is not None else GradBuckets.plan_sharded(
             params, param_specs, shard_size=fsdp_size,
             bucket_bytes=bucket_bytes)
-        # Full-rank specs: shard_map wants one entry per dim.
-        spec_leaves = [
-            P(*(tuple(s) + (None,) * (len(shp) - len(tuple(s)))))
-            for s, shp in zip(
-                jax.tree.leaves(param_specs,
-                                is_leaf=lambda x: isinstance(x, P)),
-                plan.shapes)]
+        # Full-rank specs: shard_map wants one entry per dim. UNEVEN leaves
+        # (shard dim not divisible by fsdp — plan.shard_pads > 0) cross the
+        # region boundary replicated: shard_map can't split an indivisible
+        # dim, so jax reshards them at entry and their grads exit whole
+        # (the scatter bucket still pads/reduces them bandwidth-optimally
+        # inside).
+        spec_leaves = []
+        uneven = []
+        for i, s in enumerate(jax.tree.leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, P))):
+            entries = list(tuple(s)) + [None] * (len(plan.shapes[i])
+                                                 - len(tuple(s)))
+            if plan._pad(i):
+                entries[plan.shard_dims[i]] = None
+                uneven.append(plan.shapes[i])
+            spec_leaves.append(P(*entries))
         p_specs = jax.tree.unflatten(plan.treedef, spec_leaves)
+        if uneven:
+            # Loud on purpose: these leaves lose the ZeRO-3 per-leaf
+            # memory saving (replicated at the boundary, whole grads) —
+            # a big uneven leaf deserves a reshape, not a silent OOM.
+            _log.warning(
+                "ZeRO-3 plan: %d leaf(s) with fsdp-indivisible sharded "
+                "dims (shapes %s) are replicated at the accum-region "
+                "boundary; their grads reduce via padded scatter buckets "
+                "but return whole", len(uneven), uneven[:4])
     else:
         plan = buckets if buckets is not None else GradBuckets.plan(
             params, bucket_bytes)
@@ -588,6 +694,8 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
             reduce_op=reduce_op, sync_group=group,
             hierarchy="hierarchical" if hier else "flat",
             zero3=zero3, n_scatter_buckets=plan.n_scatter_buckets,
+            n_padded_buckets=sum(1 for b in range(plan.n_buckets)
+                                 if plan._is_padded(b)),
             levels=levels)
 
     def gather_params(p):
@@ -596,8 +704,11 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         out = []
         for i, leaf in enumerate(jax.tree.leaves(p)):
             d = plan.shard_dims[i]
-            out.append(leaf if d is None else jax.lax.all_gather(
-                leaf, FSDP, axis=d, tiled=True))
+            # Uneven leaves entered the region whole (boundary spec P()):
+            # nothing to gather.
+            out.append(leaf if d is None or plan._pad(i)
+                       else jax.lax.all_gather(leaf, FSDP, axis=d,
+                                               tiled=True))
         return jax.tree.unflatten(plan.treedef, out)
 
     def spmd(params, local):
@@ -640,14 +751,26 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         (loss, aux, acc), _ = jax.lax.scan(
             body, (jnp.float32(0.0), jnp.float32(0.0), acc0), mbs)
         # Tail: "rs" buckets re-gather ONCE over their scatter group;
-        # scatter buckets stay in the shard layout (that IS the output).
-        full = []
+        # even scatter buckets stay in the shard layout (that IS the
+        # output); PADDED scatter buckets re-gather over fsdp and unpad —
+        # their leaves exit the region whole.
+        leaf_out: list = [None] * len(plan.shapes)
         for b, (a, n) in enumerate(zip(acc, plan.bucket_numel)):
-            if sched[b][0] == "rs":
-                a = jax.lax.all_gather(a, rs_axes, tiled=True)[:n]
-            full.append(a)
+            mode = sched[b][0]
+            if mode == "rs":
+                buf = jax.lax.all_gather(a, rs_axes, tiled=True)[:n]
+                parts = plan.leaf_buffers(b, buf, layout="full")
+            elif mode == "scatter" and plan._is_padded(b):
+                buf = jax.lax.all_gather(a, FSDP, tiled=True)
+                parts = plan.leaf_buffers(b, buf, layout="gathered")
+            elif mode == "scatter":
+                parts = plan.leaf_buffers(b, a, layout="shard")
+            else:
+                parts = plan.leaf_buffers(b, a, layout="full")
+            for i, v in parts.items():
+                leaf_out[i] = v
         denom = microbatches * group
-        tree = plan.unpack_shards(full) if zero3 else plan.unpack(full)
+        tree = jax.tree.unflatten(plan.treedef, leaf_out)
         grads = jax.tree.map(lambda b: b / denom, tree)
         loss = jax.lax.psum(loss, axes) / denom
         aux = jax.lax.psum(aux, axes) / denom

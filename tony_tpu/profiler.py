@@ -70,6 +70,34 @@ def reset_overlap_records() -> None:
     OVERLAP_RECORDS.clear()
 
 
+# ---------------------------------------------------------------------------
+# Checkpoint-plane instrumentation (tony_tpu.ckpt): the async snapshot
+# engine records per-save timing — the stall the train loop actually paid
+# (slot wait + device→host extract) vs the background write/commit time —
+# keyed by tag ("async_save", "blocking_save"); last save per tag wins.
+# run_ckpt_bench serializes this next to the overlap records so "async
+# saves overlap training" is a measured number, not a design claim.
+CKPT_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_ckpt(tag: str, **fields) -> None:
+    """Bank one checkpoint-save record (stall/extract/write seconds,
+    payload bytes, chunk count...)."""
+    CKPT_RECORDS[tag] = dict(fields)
+
+
+def ckpt_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded checkpoint save (deep-copied — same
+    aliasing contract as :func:`overlap_report`)."""
+    import copy
+
+    return {k: copy.deepcopy(v) for k, v in CKPT_RECORDS.items()}
+
+
+def reset_ckpt_records() -> None:
+    CKPT_RECORDS.clear()
+
+
 def _trace_fn():
     """Resolve a capture callable ``(addr, logdir, duration_ms) -> None``.
     Import is deferred and gated: the profiler client is an optional

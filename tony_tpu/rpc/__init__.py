@@ -289,8 +289,13 @@ class ApplicationRpcHandler:
             "callback_info": dict(self.callback_info),
         }
 
-    def rpc_heartbeat(self, job_type: str, index: int) -> bool:
-        self.session.on_heartbeat(job_type, index)
+    def rpc_heartbeat(self, job_type: str, index: int,
+                      ckpt_step: Optional[int] = None) -> bool:
+        """Liveness + checkpoint progress: executors that see a
+        ``tony.ckpt.dir`` piggyback the last COMMITTED step on the
+        heartbeat so the AM knows what a gang restart resumes from
+        (optional param — seed-era executors send none)."""
+        self.session.on_heartbeat(job_type, index, ckpt_step=ckpt_step)
         return True
 
     def rpc_register_execution_result(self, job_type: str, index: int,

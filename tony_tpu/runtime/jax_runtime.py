@@ -174,6 +174,19 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
                 constants.ENV_XLA_FLAGS, "")
             env[constants.ENV_XLA_FLAGS] = overlap_xla_flags(
                 user_flags, multislice=slices > 1)
+        # Checkpoint plane (tony_tpu.ckpt): ship the conf-configured
+        # durable dir + cadence to the user process so train_loop's
+        # save_every/restore_on_start defaults light up without script
+        # changes — the script-side half of the gang-restart resume
+        # contract (the executor's heartbeat reports the committed step
+        # back from the same directory).
+        ckpt_dir = ctx.conf.get(conf_mod.CKPT_DIR)
+        if ckpt_dir:
+            env[constants.ENV_CKPT_DIR] = ckpt_dir
+            env[constants.ENV_CKPT_EVERY] = str(
+                ctx.conf.get_int(conf_mod.CKPT_EVERY, 0))
+            env[constants.ENV_CKPT_KEEP] = str(
+                ctx.conf.get_int(conf_mod.CKPT_KEEP, 3))
         # Profiler hook (SURVEY.md §5.1): tony_tpu.distributed.initialize
         # starts jax.profiler.start_server on this port in the user
         # process. The port is executor-reserved and EPHEMERAL (shipped to

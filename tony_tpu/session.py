@@ -68,6 +68,9 @@ class TonyTask:
         self.start_time: float = 0.0
         self.end_time: float = 0.0
         self.preemption_retries = 0
+        # Last checkpoint step this task reported committed (heartbeat
+        # piggyback; None until a tony.ckpt.dir executor reports one).
+        self.ckpt_step: Optional[int] = None
         self.metrics: Dict[str, float] = {}
         # Timeline of TaskMonitor samples (reference: the per-task metric
         # history MetricsRpc accumulates for the portal). Bounded: at the
@@ -111,6 +114,7 @@ class TonyTask:
             "tracked": self.tracked,
             "exit_code": self.exit_code,
             "diagnostics": self.diagnostics,
+            "ckpt_step": self.ckpt_step,
             "metrics": dict(self.metrics),
             "metrics_samples": len(self.metrics_history),
         }
@@ -235,8 +239,22 @@ class TonySession:
                     t.status = TaskStatus.RUNNING
                     t.start_time = t.start_time or now
 
-    def on_heartbeat(self, job_type: str, index: int) -> None:
-        self.task(job_type, index).touch()
+    def on_heartbeat(self, job_type: str, index: int,
+                     ckpt_step: Optional[int] = None) -> None:
+        t = self.task(job_type, index)
+        t.touch()
+        if ckpt_step is not None:
+            t.ckpt_step = int(ckpt_step)
+
+    def last_committed_step(self) -> Optional[int]:
+        """Newest checkpoint step any executor has reported committed —
+        what the next attempt will resume from (commit is global: process
+        0 renames the manifest only after every process's shards landed,
+        so ANY reporter reflects the gang-wide durable state)."""
+        with self.lock:
+            steps = [t.ckpt_step for t in self._tasks.values()
+                     if t.ckpt_step is not None]
+            return max(steps) if steps else None
 
     def on_task_result(self, job_type: str, index: int, exit_code: int,
                        diagnostics: str = "") -> TonyTask:
