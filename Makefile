@@ -4,14 +4,16 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-slow quick test
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-slow quick test
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
-# (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh) and
-# the checkpoint leg (crash consistency / async overlap / elastic restore)
-# so a regression there fails the make target by name, not just as one
-# more dot.
-tier1: tier1-verify tier1-multislice tier1-ckpt
+# (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
+# checkpoint leg (crash consistency / async overlap / elastic restore) and
+# the data-plane leg (deterministic sharding / prefetch / iterator-state
+# resume) so a regression there fails the make target by name, not just
+# as one more dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule:
+# e2e timing tests flake under CPU contention).
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -28,6 +30,12 @@ tier1-multislice:
 # tier1-slow instead.
 tier1-ckpt:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'ckpt and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Input-data-plane marker leg (tmpdir/array-backed; also inside
+# tier1-verify's selection) — deterministic sharding, shuffle RNG,
+# prefetch overlap, checkpointable iterator resume.
+tier1-data:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'data and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

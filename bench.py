@@ -145,6 +145,22 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["ckpt_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_INPUT", "1") != "0":
+        # Input-plane leg (tony_tpu.data): per-step wait-on-data with the
+        # prefetching device iterator at depth 0/1/2 over a feed with
+        # simulated I/O latency. Runs on CPU too — like the ckpt leg, the
+        # feed-vs-compute overlap is real on any backend.
+        try:
+            from tony_tpu.benchmark import run_input_bench
+            di = run_input_bench()
+            result["input_stall_ms_depth0"] = di["input_stall_ms_depth0"]
+            result["input_stall_ms_depth1"] = di["input_stall_ms_depth1"]
+            result["input_stall_ms_depth2"] = di["input_stall_ms_depth2"]
+            result["input_stall_hidden"] = di["stall_hidden"]
+            result["input_per_depth"] = di["per_depth"]
+        except Exception as e:  # secondary metric must not sink the bench
+            result["input_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

@@ -40,7 +40,7 @@ _TIER_BY_MODULE = {
     "test_conf": "quick", "test_session": "quick", "test_rpc": "quick",
     "test_runtimes": "quick", "test_security": "quick",
     "test_executor": "quick", "test_satellites": "quick",
-    "test_checkpoint": "jit", "test_ckpt": "jit",
+    "test_checkpoint": "jit", "test_ckpt": "jit", "test_data": "jit",
     "test_ops": "jit", "test_models": "jit",
     "test_moe": "jit", "test_batchnorm": "jit", "test_parallel": "jit",
     "test_pipeline": "jit", "test_overlap": "jit", "test_multislice": "jit",

@@ -112,6 +112,31 @@ class TestFormat:
         restored = ckpt.restore_pytree(tmp_path, {"w": np.zeros((2,))})
         np.testing.assert_array_equal(restored["w"], 4 * np.ones((2,)))
 
+    def test_keep_gc_ignores_inflight_tmp(self, tmp_path):
+        """tony.ckpt.keep GC contract: only the newest K COMMITTED step
+        dirs survive a save, and an in-flight .tmp staging dir neither
+        counts toward K nor gets deleted by the prune."""
+        c = ckpt.AsyncCheckpointer(tmp_path, keep=2)
+        for s in (1, 2, 3):
+            c.save({"w": jnp.ones((2,)) * s}, step=s, block=True)
+        assert fmt.committed_steps(tmp_path) == [2, 3]
+        # Simulate a sibling's in-flight save: staged shards, no commit.
+        inflight = tmp_path / "step_00000005.tmp"
+        inflight.mkdir()
+        (inflight / fmt.shard_file_name(0)).write_bytes(b"staging")
+        c.save({"w": jnp.ones((2,)) * 4}, step=4, block=True)
+        c.close()
+        # K counts committed steps only; the .tmp neither displaced a
+        # committed survivor nor was reclaimed by prune.
+        assert fmt.committed_steps(tmp_path) == [3, 4]
+        assert inflight.is_dir()
+        assert (inflight / fmt.shard_file_name(0)).read_bytes() \
+            == b"staging"
+        # Direct prune: same contract without a save in the way.
+        assert fmt.prune(tmp_path, 1) == [3]
+        assert fmt.committed_steps(tmp_path) == [4]
+        assert inflight.is_dir()
+
     def test_corrupt_payload_raises_crc(self, tmp_path):
         c = ckpt.AsyncCheckpointer(tmp_path, keep=3)
         c.save({"w": jnp.ones((8, 8))}, step=1, block=True)

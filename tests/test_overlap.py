@@ -532,13 +532,13 @@ def test_record_failure_logs_debug_once(monkeypatch, caplog):
     nor stay silent — one DEBUG line on the first failure, then quiet."""
     import logging
 
-    monkeypatch.setattr(overlap, "_record_failed", False)
+    monkeypatch.setattr(profiler, "_SAFE_RECORD_FAILED", set())
 
     def boom(*a, **kw):
         raise RuntimeError("profiler wired wrong")
 
     monkeypatch.setattr(profiler, "record_overlap", boom)
-    with caplog.at_level(logging.DEBUG, logger="tony_tpu.parallel.overlap"):
+    with caplog.at_level(logging.DEBUG, logger="tony_tpu.profiler"):
         overlap._record("t1", n=1)      # must not raise
         overlap._record("t2", n=2)
     hits = [r for r in caplog.records if "profiler record" in r.message]

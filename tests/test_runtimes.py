@@ -214,6 +214,19 @@ def test_jax_ckpt_env_exported_from_conf():
     assert constants.ENV_CKPT_DIR not in bare
 
 
+def test_jax_data_seed_env_exported_from_conf():
+    """tony.data.seed reaches the user process as TONY_DATA_SEED (the
+    Dataset default seed — the whole gang, and every restart of it, must
+    derive the identical example stream); absent when unset."""
+    env = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0,
+                conf_extra={"tony.data.seed": "1234"}))
+    assert env[constants.ENV_DATA_SEED] == "1234"
+    bare = get_framework("jax").task_adapter().build_task_env(
+        ctx_for("jax", "worker", 0))
+    assert constants.ENV_DATA_SEED not in bare
+
+
 def test_jax_ckpt_env_not_exported_to_sidecars():
     """Sidecars are outside the SPMD world: they must not inherit the
     checkpoint wiring (a tensorboard task scanning/saving into the train

@@ -1,0 +1,30 @@
+"""Trace-side profiler recording shared by the overlap, ckpt, and input
+planes. One shim instead of a per-module copy: the import is lazy (the
+calling planes stay importable without the profiler stack) and every
+failure is swallowed (bookkeeping must never sink a step or a save).
+Failures past a successful import get the log-once-per-registry
+diagnostics in :func:`tony_tpu.profiler.safe_record`; a failure of the
+import itself is logged once here — otherwise a broken profiler wiring
+would silently drop every record forever.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_logger = logging.getLogger(__name__)
+_import_warned = False
+
+
+def trace_record(kind: str, tag: str, **fields) -> None:
+    global _import_warned
+    try:
+        from tony_tpu import profiler
+        record = profiler.safe_record   # never raises past this point
+    except Exception:  # noqa: BLE001
+        if not _import_warned:
+            _import_warned = True
+            _logger.debug("profiler unavailable; dropping %r records",
+                          kind, exc_info=True)
+        return
+    record(kind, tag, **fields)

@@ -358,6 +358,94 @@ def run_ckpt_bench(*, hidden: int = 2048, steps: int = 4, saves: int = 3,
     }
 
 
+def run_input_bench(*, steps: int = 24, global_batch: int = 32,
+                    hidden: int = 512, examples: int = 512,
+                    feed_latency_ms: float = 3.0,
+                    depths: tuple = (0, 1, 2)) -> dict:
+    """Input-plane leg (tony_tpu.data): per-step wait-on-data at prefetch
+    depth 0/1/2 over the SAME deterministic pipeline and train step.
+
+    The pipeline's map stage sleeps ``feed_latency_ms`` per batch —
+    simulated feed LATENCY (disk seek / decode wait / remote read), the
+    component prefetch can hide on any backend (a CPU-bound map would
+    contend with the XLA step on CPU and say nothing about TPU). Depth 0
+    pays the latency inside every ``next()``; depth >= 1 stages batches
+    from the background thread while the device steps, so the measured
+    wait collapses to the queue pop. ``stall_hidden`` (depth-1 wait under
+    half the depth-0 wait) gates the headline, mirroring ``overlap_ok``
+    in the ckpt bench.
+    """
+    import numpy as np
+    import optax
+
+    from tony_tpu import data as data_mod
+    from tony_tpu import parallel as par
+    from tony_tpu import profiler
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+
+    mesh = par.make_mesh()
+    model = get_model("mnist-mlp", hidden=hidden)
+    kx, ky, kr = jax.random.split(jax.random.PRNGKey(0), 3)
+    x0 = jax.random.normal(kx, (global_batch, 784), jnp.float32)
+    state0 = tr.create_train_state(model, optax.sgd(0.1, momentum=0.9),
+                                   x0, kr)
+    step = tr.make_train_step(mesh=mesh, donate=False)
+    xs = np.asarray(jax.random.normal(kx, (examples, 784), jnp.float32))
+    ys = np.asarray(jax.random.randint(ky, (examples,), 0, 10))
+
+    def slow_map(batch):
+        time.sleep(feed_latency_ms / 1e3)
+        return batch
+
+    def make_iter(depth):
+        ds = (data_mod.Dataset.from_arrays({"x": xs, "y": ys}, seed=0)
+              .shuffle().repeat().batch(global_batch).map(slow_map))
+        return data_mod.DeviceIterator(
+            ds.iterator(data_mod.ShardSpec(0, 1)), mesh, depth=depth,
+            tag=f"input_d{depth}")
+
+    profiler.reset_input_records()
+    out: dict = {"metric": "input_bench", "global_batch": global_batch,
+                 "steps": steps, "feed_latency_ms": feed_latency_ms,
+                 "backend": jax.default_backend()}
+    per_depth = {}
+    for depth in depths:
+        it = make_iter(depth)
+        state = state0
+        try:
+            # Warm: compile the step and (depth >= 1) fill the staging
+            # queue before the timed window.
+            state, _ = step(state, next(it))
+            jax.block_until_ready(state.params)
+            n_warm = it.stats["steps"]
+            warm_wait_s = it.stats["wait_s_total"]
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, _ = step(state, next(it))
+            jax.block_until_ready(state.params)
+            wall = time.perf_counter() - t0
+            n_timed = it.stats["steps"] - n_warm
+            timed_wait_s = it.stats["wait_s_total"] - warm_wait_s
+            per_depth[depth] = {
+                "step_ms": round(1e3 * wall / steps, 3),
+                "input_wait_ms": round(1e3 * timed_wait_s / n_timed, 3),
+            }
+        finally:
+            it.close()
+    out["per_depth"] = {str(k): v for k, v in per_depth.items()}
+    d0 = per_depth.get(0, {}).get("input_wait_ms")
+    d1 = per_depth.get(1, {}).get("input_wait_ms")
+    out["input_stall_ms_depth0"] = d0
+    out["input_stall_ms_depth1"] = d1
+    out["input_stall_ms_depth2"] = \
+        per_depth.get(2, {}).get("input_wait_ms")
+    out["stall_hidden"] = bool(d0 is not None and d1 is not None
+                               and d1 < 0.5 * d0)
+    out["input_records"] = profiler.input_report()
+    return out
+
+
 def peak_flops(on_tpu: bool | None = None) -> float:
     """THE peak-FLOPs rule for MFU accounting (single definition — every
     bench leg divides by this): the chip generation's bf16 peak on TPU, a

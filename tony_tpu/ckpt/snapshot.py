@@ -25,6 +25,7 @@ Writer errors never vanish: they surface on the next ``save``/``wait``.
 
 from __future__ import annotations
 
+import functools
 import queue
 import threading
 import time
@@ -35,17 +36,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from tony_tpu._trace import trace_record
 from tony_tpu.ckpt import format as fmt
 
 
-def _record(tag: str, **fields) -> None:
-    # Trace-side channel into the profiler registry (lazy + guarded like
-    # overlap._record: bookkeeping must never sink a save).
-    try:
-        from tony_tpu import profiler
-        profiler.record_ckpt(tag, **fields)
-    except Exception:  # noqa: BLE001
-        pass
+# Trace-side channel into the profiler registry (shared shim: lazy
+# import + swallow-all, log-once lives in profiler.safe_record).
+_record = functools.partial(trace_record, "ckpt")
 
 
 def _is_saveable(leaf: Any) -> bool:

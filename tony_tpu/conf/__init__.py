@@ -96,6 +96,12 @@ MEGASCALE_PORT = "tony.jax.megascale.port"
 CKPT_DIR = "tony.ckpt.dir"
 CKPT_EVERY = "tony.ckpt.every"            # save every N steps (0 = final only)
 CKPT_KEEP = "tony.ckpt.keep"              # committed steps retained (def. 3)
+# Input-data plane (tony_tpu.data): seed of the deterministic global
+# example stream. Exported to jax tasks as TONY_DATA_SEED (Dataset's
+# default seed) so every process in the gang — and every RESTART of the
+# gang — derives the identical stream; the per-host shard comes from the
+# rendezvous identity, not from conf.
+DATA_SEED = "tony.data.seed"
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

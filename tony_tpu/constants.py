@@ -44,6 +44,13 @@ ENV_PROFILER_PORT = "TONY_PROFILER_PORT"    # jax.profiler server (§5.1 hook)
 ENV_CKPT_DIR = "TONY_CKPT_DIR"
 ENV_CKPT_EVERY = "TONY_CKPT_EVERY"
 ENV_CKPT_KEEP = "TONY_CKPT_KEEP"
+# Input-data plane (tony_tpu.data): JAXRuntime exports tony.data.seed so
+# the whole gang derives the SAME deterministic example stream without the
+# script threading a seed through (Dataset's default seed). The shard
+# itself needs no new env — ShardSpec.from_env reads the rendezvous pair
+# (TONY_PROCESS_ID/TONY_NUM_PROCESSES) with the generic executor pair
+# (TONY_TASK_INDEX/TONY_NUM_TASKS) as fallback.
+ENV_DATA_SEED = "TONY_DATA_SEED"
 
 # TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
 ENV_TF_CONFIG = "TF_CONFIG"

@@ -36,6 +36,7 @@ builds that layer natively:
 
 from __future__ import annotations
 
+import functools
 import logging
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -46,6 +47,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tony_tpu import compat
+from tony_tpu._trace import trace_record
 from tony_tpu.parallel import DATA, FSDP, SLICE
 
 _log = logging.getLogger(__name__)
@@ -489,23 +491,9 @@ class GradBuckets:
         return self.unpack(out)
 
 
-_record_failed = False
-
-
-def _record(tag: str, **fields) -> None:
-    # Trace-time side channel into the profiler registry (lazy import:
-    # parallel must stay importable without the profiler stack).
-    global _record_failed
-    try:
-        from tony_tpu import profiler
-        profiler.record_overlap(tag, **fields)
-    except Exception:   # noqa: BLE001 — bookkeeping must never sink a step
-        if not _record_failed:
-            # Once at DEBUG (not per trace): a broken profiler wiring is
-            # diagnosable without a silent hole and without log spam.
-            _record_failed = True
-            _log.debug("overlap profiler record %r failed; further "
-                       "failures suppressed", tag, exc_info=True)
+# Trace-time side channel into the profiler registry (shared shim: lazy
+# import + swallow-all, log-once lives in profiler.safe_record).
+_record = functools.partial(trace_record, "overlap")
 
 
 def _present(mesh: Mesh, axes: Sequence[str]) -> Tuple[str, ...]:

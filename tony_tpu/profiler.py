@@ -24,6 +24,7 @@ defaults collect nothing from a remote jax server.
 
 from __future__ import annotations
 
+import logging
 import sys
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -96,6 +97,56 @@ def ckpt_report() -> Dict[str, Dict[str, object]]:
 
 def reset_ckpt_records() -> None:
     CKPT_RECORDS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Input-plane instrumentation (tony_tpu.data): the prefetching device
+# iterator records, per delivered batch, the time the train loop actually
+# blocked waiting on the feed (the input stall — the transfer T3 says must
+# hide under compute) plus rolling means of wait and host→device placement
+# time. Keyed by iterator tag (default "input"); last step per tag wins.
+# run_input_bench serializes this next to the overlap/ckpt records so
+# "prefetch hides the feed" is a measured number (BENCH_r08).
+INPUT_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_input(tag: str, **fields) -> None:
+    """Bank one input-feed record (prefetch depth, steps, last/total wait
+    seconds, mean wait/placement ms...)."""
+    INPUT_RECORDS[tag] = dict(fields)
+
+
+def input_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded input feed (deep-copied — same aliasing
+    contract as :func:`overlap_report`)."""
+    import copy
+
+    return {k: copy.deepcopy(v) for k, v in INPUT_RECORDS.items()}
+
+
+def reset_input_records() -> None:
+    INPUT_RECORDS.clear()
+
+
+# One guarded entry point for the trace-side recorders (overlap grad sync,
+# ckpt snapshot, input prefetch): bookkeeping must never sink a step or a
+# save, and a broken wiring is logged once per registry at DEBUG — not per
+# trace — so it stays diagnosable without log spam.
+_SAFE_RECORD_FAILED: set = set()
+
+
+def safe_record(kind: str, tag: str, **fields) -> None:
+    """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
+    ``"input"``), swallowing any failure."""
+    try:
+        {"overlap": record_overlap, "ckpt": record_ckpt,
+         "input": record_input}[kind](tag, **fields)
+    except Exception:  # noqa: BLE001
+        if kind not in _SAFE_RECORD_FAILED:
+            _SAFE_RECORD_FAILED.add(kind)
+            logging.getLogger(__name__).debug(
+                "%s profiler record %r failed; further failures "
+                "suppressed", kind, tag, exc_info=True)
 
 
 def _trace_fn():

@@ -187,6 +187,13 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
                 ctx.conf.get_int(conf_mod.CKPT_EVERY, 0))
             env[constants.ENV_CKPT_KEEP] = str(
                 ctx.conf.get_int(conf_mod.CKPT_KEEP, 3))
+        # Input-data plane (tony_tpu.data): ship the stream seed so every
+        # process — and every gang RESTART — builds the identical
+        # deterministic example stream (Dataset's default seed). The
+        # shard identity itself rides the rendezvous env above.
+        data_seed = ctx.conf.get(conf_mod.DATA_SEED)
+        if data_seed is not None:
+            env[constants.ENV_DATA_SEED] = str(data_seed)
         # Profiler hook (SURVEY.md §5.1): tony_tpu.distributed.initialize
         # starts jax.profiler.start_server on this port in the user
         # process. The port is executor-reserved and EPHEMERAL (shipped to

@@ -17,12 +17,15 @@ loop a library so examples and benchmarks share one GSPMD path:
 
 from __future__ import annotations
 
+import logging
 import os
+import weakref
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax.training.train_state import TrainState
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -31,6 +34,8 @@ from tony_tpu import constants
 from tony_tpu import parallel as par
 from tony_tpu.compat import mesh_context
 from tony_tpu.parallel import overlap
+
+_log = logging.getLogger(__name__)
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -280,7 +285,8 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
 
 def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                                                     Tuple[TrainState, Any]],
-               batches: Iterable[Any], *,
+               batches: Optional[Iterable[Any]] = None, *,
+               data: Optional[Any] = None,
                ckpt_dir: Optional[str] = None,
                save_every: Optional[int] = None,
                keep: Optional[int] = None,
@@ -310,10 +316,29 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
       step to the AM over the heartbeat RPC, so the attempt log shows what
       a restart will resume from.
 
+    ``data=`` attaches a framework-owned input iterator
+    (:class:`tony_tpu.data.DeviceIterator` / ``PipelineIterator`` — any
+    iterable with ``state()``/``restore()``) instead of ``batches``: the
+    pipeline cursor is then saved INSIDE the same committed step as the
+    train state (one atomic commit for both — see
+    :mod:`tony_tpu.data.ckptio`) and restored with it, so a resumed run's
+    example stream is element-identical to an uninterrupted one, even
+    when the gang restarts with a different host count (the cursor is
+    global; the new ShardSpecs re-slice it). A bare pre-data checkpoint
+    restores the model alone and the stream starts from the iterator's
+    current position.
+
     Returns ``(state, last_metrics)``.
     """
     from tony_tpu import ckpt as ckpt_mod
 
+    if (batches is None) == (data is None):
+        raise ValueError("train_loop needs exactly one of batches= or "
+                         "data=")
+    if data is not None:
+        batches = data
+    stateful_data = (data is not None and hasattr(data, "state")
+                     and hasattr(data, "restore"))
     if ckpt_dir is None:
         ckpt_dir = os.environ.get(constants.ENV_CKPT_DIR) or None
     if save_every is None:
@@ -323,9 +348,38 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
         keep = int(os.environ.get(constants.ENV_CKPT_KEEP, "3") or 3)
     mgr = None
     if ckpt_dir:
+        from tony_tpu.data import ckptio
+
         mgr = ckpt_mod.AsyncCheckpointer(ckpt_dir, keep=keep)
         if restore_on_start:
-            state = ckpt_mod.restore_latest(ckpt_dir, state, mesh=mesh)
+            latest = ckpt_mod.latest_step(ckpt_dir)
+            if latest is not None and ckptio.has_iter_state(ckpt_dir,
+                                                           latest):
+                # Wrapped {model, data_iter} checkpoint: unwrap keyed on
+                # what the manifest CONTAINS, not on what this caller
+                # passed — a batches= run restoring a data= run's save
+                # must still get the model (the strict-mode tree-mismatch
+                # KeyError it would otherwise hit reads like a wrong
+                # model, not a wrapped checkpoint).
+                state = ckpt_mod.restore_pytree(
+                    ckpt_dir, {ckptio.MODEL_KEY: state}, step=latest,
+                    mesh=mesh)[ckptio.MODEL_KEY]
+                if stateful_data:
+                    data.restore(ckptio.load_iter_state(ckpt_dir, latest))
+                else:
+                    _log.warning(
+                        "checkpoint step %d carries data-iterator state "
+                        "but this train_loop has no stateful data=; the "
+                        "model resumes, the input stream starts from the "
+                        "beginning", latest)
+            else:
+                state = ckpt_mod.restore_latest(ckpt_dir, state, mesh=mesh)
+
+    def payload():
+        if stateful_data:
+            return ckptio.wrap_for_save(state, data.state())
+        return state
+
     metrics: Dict[str, Any] = {}
     done = 0
     saved_at: Optional[int] = None
@@ -338,24 +392,120 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
             if mgr is not None and save_every and done % save_every == 0:
                 saved_at = int(jax.device_get(state.step)) \
                     if hasattr(state, "step") else done
-                mgr.save(state, step=saved_at)
+                mgr.save(payload(), step=saved_at)
         if mgr is not None and save_final and done:
             final = int(jax.device_get(state.step)) \
                 if hasattr(state, "step") else done
             if final != saved_at:
-                mgr.save(state, step=final)
+                mgr.save(payload(), step=final)
         if mgr is not None:
             mgr.wait()
     finally:
         if mgr is not None:
             mgr.close()
+        # The loop owns the iteration: release the prefetch thread and
+        # its staged device batches even when step_fn raises (close() is
+        # idempotent and state() still reads the delivered cursor after).
+        if data is not None and hasattr(data, "close"):
+            data.close()
     return state, metrics
 
 
+def _validate_local_batch(mesh: Mesh, local_batch: Dict[str, Any],
+                          seq_axis: bool = False) -> None:
+    """Pre-flight the ``make_array_from_process_local_data`` contract and
+    raise a ``ValueError`` NAMING the offending leaf — the raw failure is
+    an opaque shape-assembly error deep inside jax. Checks (local-side
+    proxies for "every process contributes the same local batch shape"):
+
+    * every leaf is array-like with a batch dim, and all leaves agree on
+      it (a per-process collective compare is impossible pre-assembly, but
+      since every process runs this same check on the same contract, a
+      divergent process fails by itself, by name);
+    * the assembled global batch dim divides the mesh's batch sharding,
+      and the local dim divides this process's share of it;
+    * with ``seq_axis``, the (process-replicated) sequence dim divides the
+      ring axis.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(local_batch)[0]
+    if not flat:
+        return
+    nproc = jax.process_count()
+    spec0 = par.batch_sharding(mesh).spec[0]
+    names = spec0 if isinstance(spec0, tuple) else (spec0,)
+    n_shards = 1
+    for a in names:
+        n_shards *= mesh.shape[a]
+    ref_path = ref_dim = None
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        if not hasattr(leaf, "shape") or np.ndim(leaf) == 0:
+            raise ValueError(
+                f"global_batch leaf {name}: expected an array with a "
+                f"leading batch dim, got {type(leaf).__name__} of rank "
+                f"{np.ndim(leaf)}")
+        dim = int(np.shape(leaf)[0])
+        if ref_dim is None:
+            ref_path, ref_dim = name, dim
+        elif dim != ref_dim:
+            raise ValueError(
+                f"global_batch leaf {name}: local batch dim {dim} != "
+                f"{ref_dim} (leaf {ref_path}) — every leaf of every "
+                f"process must contribute the same local batch count")
+        if seq_axis and np.ndim(leaf) >= 2:
+            seq = int(np.shape(leaf)[1])
+            seq_shards = mesh.shape[par.SEQ]
+            if seq % seq_shards:
+                raise ValueError(
+                    f"global_batch leaf {name}: sequence dim {seq} not "
+                    f"divisible by the {seq_shards}-way ring axis "
+                    f"({par.SEQ!r}) of the mesh")
+    global_dim = ref_dim * nproc
+    if global_dim % n_shards:
+        raise ValueError(
+            f"global_batch leaf {ref_path}: local batch dim {ref_dim} x "
+            f"{nproc} process(es) = global {global_dim}, not divisible by "
+            f"the {n_shards}-way batch sharding {tuple(names)} of the "
+            f"mesh — pad or resize the per-process batch")
+    if n_shards % nproc == 0:
+        per_proc = n_shards // nproc
+        if per_proc and ref_dim % per_proc:
+            raise ValueError(
+                f"global_batch leaf {ref_path}: local batch dim {ref_dim} "
+                f"not divisible by this process's {per_proc} addressable "
+                f"batch shard(s) ({n_shards}-way sharding over {nproc} "
+                f"process(es))")
+
+
+# Contracts already validated, mesh → {(seq_axis, treedef, leaf shapes)}:
+# the shape contract is invariant per pipeline, so per-step callers pay
+# the full pre-flight once, not every step. Only successes are cached —
+# a bad contract re-raises on every call. Weakly keyed so cached meshes
+# are released with their last outside reference; per-mesh bound as a
+# backstop against pathological ever-changing shapes (when full,
+# validation just runs).
+_VALIDATED_CONTRACTS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_VALIDATED_CONTRACTS_MAX = 256
+
+
 def global_batch(mesh: Mesh, local_batch: Dict[str, Any],
-                 seq_axis: bool = False) -> Dict[str, jax.Array]:
+                 seq_axis: bool = False,
+                 check: bool = True) -> Dict[str, jax.Array]:
     """Assemble the logically-global batch from this process's local shard —
-    every process calls this with its own slice (multi-host feeding)."""
+    every process calls this with its own slice (multi-host feeding).
+    ``check`` pre-flights the shape contract with a leaf-naming
+    ``ValueError`` instead of jax's opaque assembly failure (memoized per
+    (mesh, treedef, leaf-shape) contract, so the per-step cost is one
+    flatten + set lookup)."""
+    if check:
+        leaves, treedef = jax.tree_util.tree_flatten(local_batch)
+        key = (seq_axis, treedef, tuple(np.shape(l) for l in leaves))
+        seen = _VALIDATED_CONTRACTS.setdefault(mesh, set())
+        if key not in seen:
+            _validate_local_batch(mesh, local_batch, seq_axis=seq_axis)
+            if len(seen) < _VALIDATED_CONTRACTS_MAX:
+                seen.add(key)
+
     def put(x):
         # Rank-1 leaves (labels, weights) can't carry the seq dim.
         sharding = par.batch_sharding(
