@@ -4,16 +4,18 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-slow quick test
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-slow quick test
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
-# checkpoint leg (crash consistency / async overlap / elastic restore) and
+# checkpoint leg (crash consistency / async overlap / elastic restore),
 # the data-plane leg (deterministic sharding / prefetch / iterator-state
-# resume) so a regression there fails the make target by name, not just
-# as one more dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule:
-# e2e timing tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data
+# resume) and the collective-scheduler leg (bucketed+prefetched forward
+# gathers / explicit MoE a2a / unified collective records) so a
+# regression there fails the make target by name, not just as one more
+# dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
+# tests flake under CPU contention).
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -36,6 +38,12 @@ tier1-ckpt:
 # prefetch overlap, checkpointable iterator resume.
 tier1-data:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'data and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Collective-scheduler marker leg (also inside tier1-verify's selection) —
+# forward-gather bucketing/prefetch bit-exactness, MoE explicit a2a vs
+# GSPMD, pipeline-edge records, unified collective_report schema.
+tier1-sched:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'sched and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

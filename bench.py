@@ -161,6 +161,32 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["input_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_SCHED", "1") != "0" and n_dev % 2 == 0:
+        # Collective-scheduler leg (tony_tpu.parallel.sched): per-leaf vs
+        # bucketed+prefetched ZeRO-3 forward gathers (exposed gather
+        # time), bit-exact step numerics, and MoE a2a-under-scan vs the
+        # GSPMD default. Runs on CPU too — the gather coalescing win
+        # (fewer, size-targeted collectives) is real on any backend; the
+        # prefetch-overlap share of it needs hardware async collectives.
+        try:
+            from tony_tpu.benchmark import run_sched_bench
+            sc = run_sched_bench(on_tpu=on_tpu)
+            result["sched_gather_per_leaf_s"] = sc["gather_per_leaf_s"]
+            result["sched_gather_bucketed_s"] = sc["gather_bucketed_s"]
+            result["sched_gather_speedup"] = sc["gather_speedup"]
+            result["sched_gather_2x_ok"] = sc["gather_2x_ok"]
+            result["sched_gather_bitexact"] = sc["gather_bitexact"]
+            result["sched_zero3_bitexact"] = sc["zero3_bitexact"]
+            result["sched_n_gather_buckets"] = sc["n_gather_buckets"]
+            result["sched_moe_numerics_ok"] = sc.get("moe_numerics_ok")
+            result["sched_moe_gspmd_s"] = sc.get("moe_gspmd_s")
+            result["sched_moe_sched_s"] = sc.get("moe_sched_s")
+            result["sched_collective_kinds"] = sorted(
+                {r.get("kind") for r in
+                 sc["collective_records"].values()})
+        except Exception as e:  # secondary metric must not sink the bench
+            result["sched_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

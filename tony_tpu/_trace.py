@@ -16,7 +16,10 @@ _logger = logging.getLogger(__name__)
 _import_warned = False
 
 
-def trace_record(kind: str, tag: str, **fields) -> None:
+def trace_record(kind: str, tag: str, /, **fields) -> None:
+    # kind/tag are positional-only: the unified collective schema puts a
+    # "kind" field in **fields and must not collide with the registry
+    # selector.
     global _import_warned
     try:
         from tony_tpu import profiler

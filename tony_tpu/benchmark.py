@@ -248,6 +248,241 @@ def run_overlap_sweep(bucket_bytes_list=(64 << 10, 256 << 10, 1 << 20,
     }
 
 
+def run_sched_bench(*, leaves: int = 96, leaf_rows: int = 16,
+                    leaf_cols: int = 64, fsdp: int | None = None,
+                    bucket_bytes: int = 256 << 10, prefetch: int = 1,
+                    microbatches: int = 4, a2a_chunks: int = 2,
+                    steps: int | None = None,
+                    on_tpu: bool | None = None) -> dict:
+    """Collective-scheduler leg (tony_tpu.parallel.sched), three probes:
+
+    1. **Forward gathers** — a ``leaves``-leaf fsdp-sharded param tree
+       gathered per leaf (the pre-scheduler path) vs coalesced into
+       shard-major byte-threshold buckets with prefetch chaining
+       (:class:`~tony_tpu.parallel.sched.GatherPlan`). The gather-only
+       step has nothing to hide under, so its wall time IS the exposed
+       gather time; ``gather_2x_ok`` (bucketed ≥ 2× faster) gates the
+       headline, and the gathered values are pinned bit-exact.
+    2. **ZeRO-3 step numerics** — ``microbatch_grads`` with
+       ``gather="bucketed"`` vs ``gather="per_leaf"`` on the same state:
+       loss and every grad leaf must match BIT-exact (bucketing is pure
+       data movement), plus both full accum-step times.
+    3. **MoE a2a** — the GSPMD dispatch-einsum path vs the scheduler's
+       explicit per-capacity-chunk ``all_to_all``
+       (:func:`~tony_tpu.parallel.sched.moe_dispatch_ffn_combine`) on an
+       ``ep`` mesh, output delta + step times. On the host-simulated mesh
+       the a2a timing is directional; the numerics and the record schema
+       are the CPU-verifiable part.
+
+    The unified ``profiler.collective_report()`` snapshot rides along so
+    the bench JSON shows every collective the step issued.
+    """
+    import flax.linen as nn
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tony_tpu import parallel as par
+    from tony_tpu import profiler
+    from tony_tpu import train as tr
+    from tony_tpu.compat import shard_map
+    from tony_tpu.models import get_model
+    from tony_tpu.models.moe import MoEMLP
+    from tony_tpu.parallel import overlap, sched
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    if steps is None:
+        steps = 20 if on_tpu else 8
+    n_dev = len(jax.devices())
+    if fsdp is None:
+        fsdp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    windows = int(os.environ.get("BENCH_WINDOWS", "3"))
+    profiler.reset_collective_records()
+
+    # --- leg 1: per-leaf vs bucketed+prefetched forward gathers --------
+    mesh = par.make_mesh(fsdp=fsdp)
+    keys = jax.random.split(jax.random.PRNGKey(0), leaves)
+    params = {f"w{i:03d}": jax.random.normal(
+        keys[i], (leaf_rows, leaf_cols), jnp.float32)
+        for i in range(leaves)}
+    specs = jax.tree.map(lambda _: P("fsdp"), params)
+    params = jax.device_put(params, jax.tree.map(
+        lambda _: NamedSharding(mesh, P("fsdp")), params))
+    plan = overlap.GradBuckets.plan_sharded(
+        params, specs, shard_size=fsdp, bucket_bytes=bucket_bytes)
+    gplan = sched.GatherPlan.from_buckets(plan, prefetch=prefetch)
+
+    def consume(leaves_full):
+        # Touch every gathered element so no gather can be elided.
+        return sum(l.sum() for l in leaves_full)
+
+    def per_leaf_fn(p):
+        def spmd(p):
+            return consume([jax.lax.all_gather(l, "fsdp", axis=0,
+                                               tiled=True)
+                            for l in jax.tree.leaves(p)])
+        return shard_map(spmd, mesh, in_specs=(specs,),
+                         out_specs=P())(p)
+
+    def bucketed_fn(p):
+        def spmd(p):
+            return consume(gplan.gather(jax.tree.leaves(p)))
+        return shard_map(spmd, mesh, in_specs=(specs,),
+                         out_specs=P())(p)
+
+    def timed(fn, arg, jit=True):
+        # One timing methodology per file: the shared best-of-N fenced
+        # window harness (warmup + loss AND param-leaf readback fences).
+        # jit=False for callables that are already jitted inside (the
+        # accum stepper: its layout detection reads committed shardings
+        # off the REAL leaves and must not be traced).
+        f = jax.jit(fn) if jit else fn
+
+        def window(carry):
+            out = None
+            for _ in range(steps):
+                out = f(carry)
+            return carry, out
+
+        def first_array(c):
+            # Fence on a device leaf (TrainState.step is a plain int).
+            return next(l for l in jax.tree_util.tree_leaves(c)
+                        if hasattr(l, "ravel"))
+
+        best, _, _ = best_window_time(window, arg, params_of=first_array,
+                                      default_windows=windows)
+        return best / steps
+
+    per_leaf_s = timed(per_leaf_fn, params)
+    bucketed_s = timed(bucketed_fn, params)
+
+    # Bit-exact pin on the gathered VALUES (bucketing is data movement).
+    def gathered_values(use_plan):
+        def spmd(p):
+            ls = jax.tree.leaves(p)
+            if use_plan:
+                return gplan.gather(ls)
+            return [jax.lax.all_gather(l, "fsdp", axis=0, tiled=True)
+                    for l in ls]
+        return shard_map(spmd, mesh, in_specs=(specs,),
+                         out_specs=[P()] * leaves)(params)
+
+    gather_exact = all(
+        np.array_equal(np.asarray(jax.device_get(a)),
+                       np.asarray(jax.device_get(b)))
+        for a, b in zip(gathered_values(True), gathered_values(False)))
+
+    # --- leg 2: ZeRO-3 accum step, bucketed vs per-leaf gathers --------
+    model = get_model("mnist-mlp", hidden=512)
+    kx, ky, kr = jax.random.split(jax.random.PRNGKey(1), 3)
+    dp = overlap.sync_size(mesh)
+    batch_n = dp * microbatches * (16 if on_tpu else 4)
+    x = jax.random.normal(kx, (batch_n, 784), jnp.float32)
+    yb = jax.random.randint(ky, (batch_n,), 0, 10)
+    data = {"x": x, "y": yb}
+    state = fsdp_shard_state(
+        tr.create_train_state(model, optax.sgd(0.1, momentum=0.9), x, kr),
+        mesh)
+    z_specs = overlap.fsdp_param_specs(state.params, mesh)
+
+    def loss_fn(p, mb):
+        logits = state.apply_fn({"params": p}, mb["x"])
+        return tr.cross_entropy_loss(logits, mb["y"])
+
+    grads_by_mode = {}
+    for mode in ("bucketed", "per_leaf"):
+        grads_by_mode[mode] = jax.jit(lambda p, b, m=mode: overlap.
+                                      microbatch_grads(
+                                          loss_fn, p, b, mesh,
+                                          microbatches=microbatches,
+                                          bucket_bytes=bucket_bytes,
+                                          param_specs=z_specs, gather=m,
+                                          prefetch=prefetch))(state.params,
+                                                             data)
+    (l_b, g_b), (l_p, g_p) = (grads_by_mode["bucketed"],
+                              grads_by_mode["per_leaf"])
+    zero3_exact = bool(float(l_b) == float(l_p)) and all(
+        np.array_equal(np.asarray(jax.device_get(a)),
+                       np.asarray(jax.device_get(b)))
+        for a, b in zip(jax.tree.leaves(g_b), jax.tree.leaves(g_p)))
+
+    step_s = {}
+    for mode in ("bucketed", "per_leaf"):
+        step_fn = tr.make_accum_train_step(
+            mesh=mesh, microbatches=microbatches,
+            bucket_bytes=bucket_bytes, gather=mode, prefetch=prefetch,
+            donate=False)
+        step_s[mode] = timed(
+            lambda st, f=step_fn: f(st, data)[1]["loss"], state,
+            jit=False)
+
+    # --- leg 3: MoE a2a under the scheduler vs GSPMD default -----------
+    moe = {}
+    ep = 2 if n_dev % 2 == 0 else 1
+    if ep > 1:
+        mesh_e = par.make_mesh(ep=ep)
+        b, t, d, f, e = (16 if on_tpu else 8), 16, 64, 128, 2 * ep
+        xk = jax.random.normal(jax.random.PRNGKey(2), (b, t, d),
+                               jnp.float32)
+        layer = MoEMLP(dim=d, ffn_hidden=f, n_experts=e, top_k=2,
+                       dtype=jnp.float32)
+        variables = {"params": nn.unbox(
+            layer.init(jax.random.PRNGKey(3), xk))["params"]}
+        w_shard = {"params": {
+            k: NamedSharding(mesh_e, P("expert"))
+            if k.startswith("w_") and k != "w_router"
+            else NamedSharding(mesh_e, P())
+            for k in variables["params"]}}
+        v_sh = jax.device_put(variables, w_shard)
+        x_sh = jax.device_put(xk, par.batch_sharding(mesh_e))
+
+        def gspmd_fn(v, xx):
+            with nn.logical_axis_rules(par.RULES):
+                return layer.apply(v, xx)
+
+        layer_s = MoEMLP(dim=d, ffn_hidden=f, n_experts=e, top_k=2,
+                         dtype=jnp.float32, explicit_a2a=True,
+                         mesh=mesh_e, a2a_chunks=a2a_chunks)
+        sched_fn = lambda v, xx: layer_s.apply(v, xx)
+        y_g = jax.jit(gspmd_fn)(v_sh, x_sh)
+        y_s = jax.jit(sched_fn)(v_sh, x_sh)
+        moe = {
+            "moe_gspmd_s": round(timed(lambda v: gspmd_fn(v, x_sh).sum(),
+                                       v_sh), 6),
+            "moe_sched_s": round(timed(lambda v: sched_fn(v, x_sh).sum(),
+                                       v_sh), 6),
+            "moe_a2a_chunks": a2a_chunks,
+            "moe_delta": float(jnp.max(jnp.abs(
+                jax.device_get(y_g) - jax.device_get(y_s)))),
+        }
+        moe["moe_numerics_ok"] = bool(moe["moe_delta"] < 1e-5)
+
+    out = {
+        "metric": "sched_bench",
+        "gather_per_leaf_s": round(per_leaf_s, 6),
+        "gather_bucketed_s": round(bucketed_s, 6),
+        "gather_speedup": round(per_leaf_s / bucketed_s, 4)
+        if bucketed_s else None,
+        "gather_2x_ok": bool(bucketed_s and per_leaf_s >= 2 * bucketed_s),
+        "gather_bitexact": bool(gather_exact),
+        "n_leaves": leaves,
+        "n_gather_buckets": gplan.n_gather_buckets,
+        "gather_nbytes": list(gplan.gather_nbytes),
+        "prefetch": prefetch,
+        "zero3_step_bucketed_s": round(step_s["bucketed"], 6),
+        "zero3_step_per_leaf_s": round(step_s["per_leaf"], 6),
+        "zero3_bitexact": bool(zero3_exact),
+        "fsdp": fsdp,
+        "microbatches": microbatches,
+        "bucket_threshold": bucket_bytes,
+        "backend": jax.default_backend(),
+        **moe,
+        "collective_records": profiler.collective_report(),
+    }
+    return out
+
+
 def run_ckpt_bench(*, hidden: int = 2048, steps: int = 4, saves: int = 3,
                    fsdp: int = 1, directory: str | None = None) -> dict:
     """Checkpoint-plane leg: blocking save wall time vs the stall an async

@@ -21,6 +21,8 @@ gather/scatter loop:
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -89,6 +91,18 @@ class MoEMLP(nn.Module):
     Input [B, T, D]; groups = batch rows (already sharded over the DP axes),
     experts sharded over the ``expert`` mesh axis — the dispatch einsum is
     where GSPMD inserts the EP ``all_to_all``.
+
+    ``explicit_a2a=True`` (with ``mesh=``) routes dispatch/FFN/combine
+    through the collective scheduler instead
+    (:func:`tony_tpu.parallel.sched.moe_dispatch_ffn_combine`): the EP
+    ``all_to_all`` is issued explicitly per capacity chunk
+    (``a2a_chunks``) inside the layer so chunk *c+1*'s a2a rides under
+    chunk *c*'s expert FFN compute, rather than whatever one-shot
+    schedule GSPMD picks for the einsum. Same math (per-chunk combine-sum
+    reassociation aside); owns only the expert axis, so it needs
+    ``tp=sp=pp=1`` and must not run inside another manual region (the
+    accum engine's) — the einsum path stays the default and the GSPMD
+    numerics pin.
     """
     dim: int
     ffn_hidden: int
@@ -97,6 +111,9 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     aux_coef: float = 0.01
     dtype: object = jnp.bfloat16
+    explicit_a2a: bool = False
+    mesh: Any = None
+    a2a_chunks: int = 2
 
     @nn.compact
     def __call__(self, x):
@@ -122,6 +139,18 @@ class MoEMLP(nn.Module):
         w_gate = stacked("w_gate", (e, d, f), ("expert", "embed", "ffn"))
         w_up = stacked("w_up", (e, d, f), ("expert", "embed", "ffn"))
         w_down = stacked("w_down", (e, f, d), ("expert", "ffn", "embed"))
+
+        if self.explicit_a2a:
+            if self.mesh is None:
+                raise ValueError(
+                    "MoEMLP(explicit_a2a=True) needs mesh=: the scheduler "
+                    "issues the a2a over the mesh's expert axis itself")
+            from tony_tpu.parallel import sched  # lazy: models stay light
+            y = sched.moe_dispatch_ffn_combine(
+                x, dispatch, combine, (w_gate, w_up, w_down), self.mesh,
+                chunks=self.a2a_chunks, dtype=self.dtype)
+            return nn.with_logical_constraint(
+                y, ("batch", "act_seq", "act_embed"))
 
         # Dispatch: [B,S,E,C] × [B,S,D] → [E,B,C,D] (the EP all_to_all).
         xin = jnp.einsum("gsec,gsd->egcd", dispatch.astype(self.dtype),

@@ -180,6 +180,8 @@ from tony_tpu.parallel.pipeline import (  # noqa: E402  (re-export)
     gpipe, gpipe_1f1b, pipelined_lm_logits, stage_split)
 from tony_tpu.parallel.overlap import (  # noqa: E402  (re-export)
     GradBuckets, fsdp_param_specs, microbatch_grads, overlap_xla_flags)
+from tony_tpu.parallel.sched import (  # noqa: E402  (re-export)
+    GatherPlan, moe_dispatch_ffn_combine)
 
 __all__ = [
     "AXES", "SLICE", "DATA", "FSDP", "PIPE", "EXPERT", "SEQ", "MODEL",
@@ -190,4 +192,5 @@ __all__ = [
     "pipelined_lm_logits", "stage_split",
     "GradBuckets", "fsdp_param_specs", "microbatch_grads",
     "overlap_xla_flags",
+    "GatherPlan", "moe_dispatch_ffn_combine",
 ]

@@ -509,7 +509,9 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                      reduce_op: str = "all_reduce",
                      has_aux: bool = False,
                      param_specs: Optional[Any] = None,
-                     hierarchy: str = "auto"):
+                     hierarchy: str = "auto",
+                     gather: str = "bucketed",
+                     prefetch: int = 1):
     """Gradient accumulation over ``microbatches`` with per-bucket sync.
 
     ``loss_fn(params, microbatch) -> loss`` (or ``(loss, aux)`` with
@@ -537,6 +539,17 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     once per plan) so a large uneven leaf — e.g. a vocab embedding whose
     dim doesn't divide fsdp — can't silently eat the ZeRO-3 budget.
 
+    **Forward gathers** (ZeRO-3 only; ``gather`` = ``"bucketed"`` |
+    ``"per_leaf"``): each microbatch re-gathers the sharded params for
+    compute. The default coalesces the per-leaf ``all_gather``s into the
+    SAME shard-major buckets the scatter plan uses (one collective per
+    bucket — bit-exact vs per-leaf, it is pure data movement) and chains
+    bucket *k*'s gather on bucket *k−prefetch*'s completion
+    (:class:`tony_tpu.parallel.sched.GatherPlan`), so the next bucket's
+    gather rides under this bucket's layer compute while replicated
+    params never materialize outside the live bucket window.
+    ``"per_leaf"`` is the pre-scheduler path, kept as the numerics pin.
+
     **Hierarchy** (``"auto"`` | ``"flat"`` | ``"hierarchical"``): on a
     multi-slice mesh (``slice`` axis > 1) the auto/hierarchical reduce is
     two-level — ``psum_scatter`` over the intra-slice ICI axes per bucket,
@@ -553,10 +566,15 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     expressed for XLA's latency-hiding scheduler — see
     :func:`overlap_xla_flags`).
     """
+    from tony_tpu.parallel import sched as sched_mod  # lazy: no cycle
+
     axes = sync_axes(mesh)
     group = sync_size(mesh)
     ici = ici_axes(mesh)
     dcn = dcn_axis(mesh)
+    if gather not in ("bucketed", "per_leaf"):
+        raise ValueError(f"unknown gather mode {gather!r} "
+                         "(bucketed|per_leaf)")
     if hierarchy not in ("auto", "flat", "hierarchical"):
         raise ValueError(f"unknown hierarchy {hierarchy!r} "
                          "(auto|flat|hierarchical)")
@@ -575,11 +593,18 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
             f"microbatches {microbatches} (= {group * microbatches})")
 
     zero3 = param_specs is not None
+    gplan = None
     if zero3:
         fsdp_size = mesh.shape[FSDP] if FSDP in mesh.axis_names else 1
         plan = buckets if buckets is not None else GradBuckets.plan_sharded(
             params, param_specs, shard_size=fsdp_size,
             bucket_bytes=bucket_bytes)
+        # The forward-gather schedule is resolved HERE, once per plan —
+        # which leaves gather, on which dim, in which bucket. The scan
+        # body below just drives the static lists (the spec probing that
+        # used to run per gather_params call is gone from the traced
+        # path).
+        gplan = sched_mod.GatherPlan.from_buckets(plan, prefetch=prefetch)
         # Full-rank specs: shard_map wants one entry per dim. UNEVEN leaves
         # (shard dim not divisible by fsdp — plan.shard_pads > 0) cross the
         # region boundary replicated: shard_map can't split an indivisible
@@ -685,19 +710,35 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
             n_padded_buckets=sum(1 for b in range(plan.n_buckets)
                                  if plan._is_padded(b)),
             levels=levels)
+    # Mirror the whole schedule into the unified collective registry: the
+    # reduce levels plus (ZeRO-3) the forward gathers, so every transfer
+    # in the step shows up in profiler.collective_report().
+    sched_mod.record_reduce_levels("accum", levels)
+    if zero3 and gplan.gather_leaves:
+        if gather == "bucketed":
+            nbytes = list(gplan.gather_nbytes)
+        else:
+            nbytes = [
+                int(np.prod(plan.shapes[i], dtype=np.int64))
+                * plan.dtypes[i].itemsize for i, _ in gplan.gather_leaves]
+        sched_mod.record_collective(
+            "accum.fwd_gather", kind="all_gather", plane="fwd_gather",
+            axes=[FSDP], nbytes=nbytes, gather=gather,
+            prefetch=gplan.prefetch if gather == "bucketed" else None,
+            per_microbatch=microbatches)
 
     def gather_params(p):
         if not zero3:
             return p
-        out = []
-        for i, leaf in enumerate(jax.tree.leaves(p)):
-            d = plan.shard_dims[i]
-            # Uneven leaves entered the region whole (boundary spec P()):
-            # nothing to gather.
-            out.append(leaf if d is None or plan._pad(i)
-                       else jax.lax.all_gather(leaf, FSDP, axis=d,
-                                               tiled=True))
-        return jax.tree.unflatten(plan.treedef, out)
+        leaves = list(jax.tree.leaves(p))
+        if gather == "bucketed":
+            return jax.tree.unflatten(plan.treedef, gplan.gather(leaves))
+        # Per-leaf pin path: replicated/scalar/uneven leaves entered the
+        # region whole and are not in the (static) drive list.
+        for i, d in gplan.gather_leaves:
+            leaves[i] = jax.lax.all_gather(leaves[i], FSDP, axis=d,
+                                           tiled=True)
+        return jax.tree.unflatten(plan.treedef, leaves)
 
     def spmd(params, local):
         mbs = jax.tree.map(

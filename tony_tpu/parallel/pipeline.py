@@ -32,10 +32,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+import numpy as np
+
 from tony_tpu import compat
 from tony_tpu.parallel import DATA, FSDP, PIPE  # noqa: F401 (PIPE is API)
+from tony_tpu.parallel import sched as _sched
 from tony_tpu.parallel.overlap import (_record as _record_schedule,
                                        sync_axes, sync_size)
+
+
+def _mb_nbytes(x: jax.Array, dp_size: int, microbatches: int) -> int:
+    """Bytes of ONE microbatch buffer on one pipeline edge — what each
+    ``ppermute`` tick moves between neighbor stages."""
+    rows = x.shape[0] // max(dp_size, 1) // microbatches
+    return int(rows * np.prod(x.shape[1:], dtype=np.int64)
+               * np.dtype(x.dtype).itemsize)
 
 
 def _local_batch(x: jax.Array, dp_size: int, microbatches: int) -> int:
@@ -134,6 +145,9 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     _record_schedule("gpipe", stages=n_stages, microbatches=microbatches,
                      ticks=microbatches + n_stages - 1)
+    _sched.record_pipeline_edges(
+        "gpipe", stages=n_stages, microbatches=microbatches,
+        mb_nbytes=_mb_nbytes(x, dp_size, microbatches))
     return compat.shard_map(
         spmd, mesh, in_specs=(p_specs, x_spec),
         out_specs=x_spec)(stage_params, x)
@@ -171,6 +185,9 @@ def gpipe_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     saved_spec = P(pipe_axis, None, dp_axes or None)
     _record_schedule("gpipe_1f1b", stages=n_stages, microbatches=m,
                      ticks=2 * (m + n_stages - 1))
+    _sched.record_pipeline_edges(
+        "gpipe_1f1b", stages=n_stages, microbatches=m,
+        mb_nbytes=_mb_nbytes(x, dp_size, m), reverse=True)
 
     def fwd_spmd(params, x_local):
         params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)

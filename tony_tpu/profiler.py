@@ -38,6 +38,18 @@ _TRACE_OPTIONS = {
 }
 
 
+def _snapshot(store: Dict[str, Dict[str, object]]
+              ) -> Dict[str, Dict[str, object]]:
+    """THE report contract, shared by every registry below: a deep copy of
+    the store — including nested per-level/per-bucket lists — so callers
+    can serialize or mutate a report without poisoning the live records
+    (the report schemas had drifted; ``tests/test_sched.py`` pins all of
+    them on this one helper)."""
+    import copy
+
+    return {k: copy.deepcopy(v) for k, v in store.items()}
+
+
 # ---------------------------------------------------------------------------
 # Overlap-engine instrumentation (the comm/compute overlap tentpole): the
 # engine's planners call :func:`record_overlap` at TRACE time — once per
@@ -59,16 +71,47 @@ def record_overlap(tag: str, **fields) -> None:
 
 
 def overlap_report() -> Dict[str, Dict[str, object]]:
-    """Snapshot of every recorded overlap plan (deep-copied — including
-    the nested per-level plans: callers serialize this into bench/metrics
-    JSON and must not alias the live registry)."""
-    import copy
-
-    return {k: copy.deepcopy(v) for k, v in OVERLAP_RECORDS.items()}
+    """Snapshot of every recorded overlap plan (deep-copied via
+    :func:`_snapshot`: callers serialize this into bench/metrics JSON and
+    must not alias the live registry)."""
+    return _snapshot(OVERLAP_RECORDS)
 
 
 def reset_overlap_records() -> None:
     OVERLAP_RECORDS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Unified collective instrumentation (the collective-scheduler tentpole):
+# ONE record schema for every inter-chip transfer the step issues —
+# forward param gathers, gradient scatter/allreduce buckets, MoE expert
+# all_to_all, pipeline ppermute edges — so "every collective is either
+# hidden or accounted for" is inspectable from one report instead of four
+# plane-specific ones. Writers go through :mod:`tony_tpu.parallel.sched`
+# (``record_collective``); keyed by tag, last plan per tag wins. Schema
+# (enforced by the sched-side writer, not here):
+#   kind   — all_gather | psum_scatter | all_reduce | all_to_all | ppermute
+#   plane  — fwd_gather | grad_reduce | moe | pipeline
+#   axes   — mesh axes the collective runs over
+#   nbytes — per-issue payload bytes (list)
+# plus freeform extras (prefetch depth, level, chunk count, measured
+# hidden/exposed seconds from the bench legs...).
+COLLECTIVE_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_collective(tag: str, /, **fields) -> None:
+    """Bank one collective schedule record under the unified schema."""
+    COLLECTIVE_RECORDS[tag] = dict(fields)
+
+
+def collective_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every scheduled collective (deep-copied via
+    :func:`_snapshot` — same aliasing contract as the other reports)."""
+    return _snapshot(COLLECTIVE_RECORDS)
+
+
+def reset_collective_records() -> None:
+    COLLECTIVE_RECORDS.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -88,11 +131,10 @@ def record_ckpt(tag: str, **fields) -> None:
 
 
 def ckpt_report() -> Dict[str, Dict[str, object]]:
-    """Snapshot of every recorded checkpoint save (deep-copied — same
-    aliasing contract as :func:`overlap_report`)."""
-    import copy
-
-    return {k: copy.deepcopy(v) for k, v in CKPT_RECORDS.items()}
+    """Snapshot of every recorded checkpoint save (deep-copied via
+    :func:`_snapshot` — same aliasing contract as
+    :func:`overlap_report`)."""
+    return _snapshot(CKPT_RECORDS)
 
 
 def reset_ckpt_records() -> None:
@@ -117,11 +159,10 @@ def record_input(tag: str, **fields) -> None:
 
 
 def input_report() -> Dict[str, Dict[str, object]]:
-    """Snapshot of every recorded input feed (deep-copied — same aliasing
-    contract as :func:`overlap_report`)."""
-    import copy
-
-    return {k: copy.deepcopy(v) for k, v in INPUT_RECORDS.items()}
+    """Snapshot of every recorded input feed (deep-copied via
+    :func:`_snapshot` — same aliasing contract as
+    :func:`overlap_report`)."""
+    return _snapshot(INPUT_RECORDS)
 
 
 def reset_input_records() -> None:
@@ -135,12 +176,13 @@ def reset_input_records() -> None:
 _SAFE_RECORD_FAILED: set = set()
 
 
-def safe_record(kind: str, tag: str, **fields) -> None:
+def safe_record(kind: str, tag: str, /, **fields) -> None:
     """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
-    ``"input"``), swallowing any failure."""
+    ``"input"``/``"collective"``), swallowing any failure."""
     try:
         {"overlap": record_overlap, "ckpt": record_ckpt,
-         "input": record_input}[kind](tag, **fields)
+         "input": record_input, "collective": record_collective}[kind](
+             tag, **fields)
     except Exception:  # noqa: BLE001
         if kind not in _SAFE_RECORD_FAILED:
             _SAFE_RECORD_FAILED.add(kind)

@@ -199,6 +199,8 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                           bucket_bytes: int = overlap.DEFAULT_BUCKET_BYTES,
                           reduce_op: str = "all_reduce",
                           hierarchy: str = "auto",
+                          gather: str = "bucketed",
+                          prefetch: int = 1,
                           donate: bool = True,
                           apply_kwargs_of: Optional[Callable[
                               [Dict[str, jax.Array]],
@@ -222,7 +224,11 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     * fsdp-sharded params (ZeRO-3, e.g. from ``create_train_state`` on an
       ``fsdp > 1`` mesh) → grads are ``psum_scatter``-ed straight into the
       shard layout and ``apply_gradients``/``global_norm`` run on sharded
-      grads — replicated gradients never materialize.
+      grads — replicated gradients never materialize. The forward param
+      ``all_gather``s are bucketed + prefetched by the collective
+      scheduler by default (``gather="bucketed"``, ``prefetch=k`` — see
+      :class:`tony_tpu.parallel.sched.GatherPlan`); ``gather="per_leaf"``
+      keeps the pre-scheduler path as the bit-exact numerics pin.
 
     On a multi-slice mesh (``MeshSpec(slices=...)``) the reduce is
     hierarchical by default: per-bucket ``psum_scatter`` over ICI, then a
@@ -254,7 +260,8 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                 loss_fn, state.params, batch, mesh,
                 microbatches=microbatches, bucket_bytes=bucket_bytes,
                 reduce_op=reduce_op, has_aux=True,
-                param_specs=param_specs, hierarchy=hierarchy)
+                param_specs=param_specs, hierarchy=hierarchy,
+                gather=gather, prefetch=prefetch)
             # ZeRO-3: grads carry the fsdp shard layout here, so the
             # optimizer update and the norm reduction below run shard-
             # local with GSPMD inserting only the tiny norm psum.
