@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-slow quick test
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-slow quick test
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -44,6 +44,13 @@ tier1-data:
 # GSPMD, pipeline-edge records, unified collective_report schema.
 tier1-sched:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'sched and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Fused-optimizer marker leg (also inside tier1-verify's selection) —
+# bucket-major update kernels pinned vs optax, padded uneven shards,
+# bucket-major grad norm/clip, leaf-major ckpt portability across
+# changed fsdp topologies.
+tier1-optim:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'optim and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

@@ -187,6 +187,27 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["sched_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_OPTIM", "1") != "0" and n_dev % 2 == 0:
+        # Fused-optimizer leg (tony_tpu.ops.fused_optim): per-leaf optax
+        # update vs the bucket-major fused update on the simulated
+        # fsdp mesh — wall time, jaxpr op counts (O(n_leaves) vs
+        # O(n_buckets) update chains), f32 bit-exact pin. Runs on CPU too:
+        # the dispatch-count win is real on any backend; the HBM
+        # bytes-bound floor (ROOFLINE.md) needs metal.
+        try:
+            from tony_tpu.benchmark import run_optim_bench
+            ob = run_optim_bench(on_tpu=on_tpu)
+            result["optim_optax_update_s"] = ob["optax_update_s"]
+            result["optim_fused_update_s"] = ob["fused_update_s"]
+            result["optim_speedup"] = ob["speedup"]
+            result["optim_n_leaves"] = ob["n_leaves"]
+            result["optim_n_buckets"] = ob["n_buckets"]
+            result["optim_optax_jaxpr_eqns"] = ob["optax_jaxpr_eqns"]
+            result["optim_fused_jaxpr_eqns"] = ob["fused_jaxpr_eqns"]
+            result["optim_numerics_ok"] = ob["numerics_ok"]
+        except Exception as e:  # secondary metric must not sink the bench
+            result["optim_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

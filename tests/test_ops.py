@@ -60,23 +60,71 @@ def test_flash_block_shrinks_to_dividing_size(causal):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_ragged_noncausal_falls_back_with_warning():
-    # Non-causal ragged shapes can't use end-padding (padded keys would
-    # soak up softmax mass) — they fall back to the reference, loudly.
+def _assert_kernel_matches_reference(q, k, v, causal, block=32):
+    """Values AND grads through the kernel path, with NO fallback warning
+    — the BENCH_r02 block-shape regression guard (ragged/odd shapes used
+    to silently materialize the T×T reference score matrix)."""
     import warnings
 
     from tony_tpu.ops import attention as att
 
-    q, k, v = rand_qkv(t=48, tk=40)
     att._warned.clear()
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
-        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32,
-                              interpret=True)
-    assert any("falling back" in str(w.message) for w in caught)
-    ref = reference_attention(q, k, v, causal=False)
+        out = flash_attention(q, k, v, causal=causal, block_q=block,
+                              block_k=block, interpret=True)
+    assert not caught
+    ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+    w = jax.random.normal(jax.random.PRNGKey(17), q.shape)
+    g_f = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, causal=causal, block_q=block, block_k=block,
+        interpret=True) * w).sum(), (0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda q, k, v: (reference_attention(
+        q, k, v, causal=causal) * w).sum(), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_ragged_noncausal_pads_and_masks():
+    # Non-causal ragged shapes used to fall back to the reference (end-
+    # padded keys would soak up softmax mass); now the kernels mask the
+    # padded keys via the static kv_len and stay on the kernel path.
+    q, k, v = rand_qkv(t=48, tk=40)
+    _assert_kernel_matches_reference(q, k, v, causal=False)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_cross_lengths_run_kernel(causal):
+    # Cross-attention lengths (t != tk, neither dividing the blocks).
+    q, k, v = rand_qkv(b=1, h=2, t=40, d=16, tk=24)
+    _assert_kernel_matches_reference(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("streamed", [False, True])
+def test_flash_ragged_streamed_kernels(streamed):
+    # The same mask through the streamed-KV kernel family.
+    from tony_tpu.ops import attention as att
+
+    old = att._RESIDENT_KV_BYTES
+    att._RESIDENT_KV_BYTES = 0 if streamed else old
+    try:
+        q, k, v = rand_qkv(b=1, h=2, t=40, tk=24, d=16)
+        _assert_kernel_matches_reference(q, k, v, causal=False)
+    finally:
+        att._RESIDENT_KV_BYTES = old
+
+
+@pytest.mark.parametrize("d", [20, 12])
+def test_flash_odd_head_dim_runs_kernel(d):
+    # head_dim off the 8-row sublane tile: zero-padded feature dim, still
+    # the kernel path — values and grads exact, output dtype/shape kept.
+    q, k, v = rand_qkv(b=1, h=2, t=32, d=d)
+    _assert_kernel_matches_reference(q, k, v, causal=True)
+    q, k, v = rand_qkv(b=1, h=2, t=40, tk=24, d=d)
+    _assert_kernel_matches_reference(q, k, v, causal=False)
 
 
 def test_flash_kernel_bf16():

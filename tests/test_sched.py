@@ -270,6 +270,7 @@ class TestReportAliasing:
         ("input", profiler.input_report, profiler.reset_input_records),
         ("collective", profiler.collective_report,
          profiler.reset_collective_records),
+        ("update", profiler.update_report, profiler.reset_update_records),
     ])
     def test_mutating_report_does_not_poison_store(self, kind, report,
                                                    reset):

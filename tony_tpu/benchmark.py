@@ -117,6 +117,14 @@ def fsdp_shard_state(state, mesh):
     shardings = jax.tree.map(
         lambda p: NamedSharding(mesh, spec_of(p)), state.params)
     params = jax.device_put(state.params, shardings)
+    from tony_tpu.ops import fused_optim
+
+    if isinstance(state.tx, fused_optim.FusedOptimizer):
+        # Bucket-resident state is planned off committed shardings, so it
+        # must be rebuilt AFTER the reshard, not GSPMD-propagated.
+        return TrainState(step=0, apply_fn=state.apply_fn, params=params,
+                          tx=state.tx,
+                          opt_state=state.tx.init_state(params, mesh))
     return TrainState.create(apply_fn=state.apply_fn, params=params,
                              tx=state.tx)
 
@@ -481,6 +489,149 @@ def run_sched_bench(*, leaves: int = 96, leaf_rows: int = 16,
         "collective_records": profiler.collective_report(),
     }
     return out
+
+
+def _count_eqns(jaxpr) -> int:
+    """Total jaxpr equation count, sub-jaxprs included — the dispatch-
+    granularity proxy the optimizer legs report (per-leaf optax updates
+    scale O(n_leaves), the fused plane O(n_buckets))."""
+    import jax.core
+
+    n = len(jaxpr.eqns)
+    for eq in jaxpr.eqns:
+        for v in eq.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for x in vs:
+                inner = getattr(x, "jaxpr", x)
+                if hasattr(inner, "eqns"):
+                    n += _count_eqns(inner)
+    return n
+
+
+def run_optim_bench(*, leaves: int = 192, leaf_rows: int = 16,
+                    leaf_cols: int = 64, fsdp: int | None = None,
+                    bucket_bytes: int = 256 << 10, rule: str = "adamw",
+                    steps: int | None = None,
+                    on_tpu: bool | None = None) -> dict:
+    """Fused-optimizer leg (tony_tpu.ops.fused_optim): per-leaf optax
+    updates vs the bucket-major fused update on a ``leaves``-leaf
+    fsdp-sharded tree (the many-small-leaves regime where the per-leaf op
+    soup is dispatch-bound — every leaf costs its own multiply/add chain
+    while the fused plane issues one update per bucket buffer).
+
+    Three numbers gate the headline: wall time per update (both paths
+    jitted, donated, fenced best-of-N), the jaxpr equation counts (the
+    O(n_leaves) vs O(n_buckets) claim, compiler-visible), and the f32
+    numerics pin (the fused params must match optax BIT-exact — the same
+    pin ``tests/test_fused_optim.py`` holds; ``numerics_ok`` gates the
+    timing claim like every other leg).
+    """
+    import numpy as np
+    import optax
+
+    from tony_tpu import parallel as par
+    from tony_tpu import profiler
+    from tony_tpu.ops import fused_optim
+    from tony_tpu.parallel import overlap
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    if steps is None:
+        steps = 20 if on_tpu else 10
+    n_dev = len(jax.devices())
+    if fsdp is None:
+        fsdp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    windows = int(os.environ.get("BENCH_WINDOWS", "3"))
+    mesh = par.make_mesh(fsdp=fsdp)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 2 * leaves)
+    params = {f"w{i:03d}": jax.random.normal(
+        keys[i], (leaf_rows, leaf_cols), jnp.float32)
+        for i in range(leaves)}
+    shardings = jax.tree.map(
+        lambda _: NamedSharding(mesh, P("fsdp")), params)
+    params = jax.device_put(params, shardings)
+    grads = jax.device_put(
+        {k: jax.random.normal(keys[leaves + i],
+                              (leaf_rows, leaf_cols), jnp.float32) * 1e-2
+         for i, k in enumerate(params)}, shardings)
+    specs = overlap.fsdp_param_specs(params, mesh)
+
+    fused = fused_optim.FusedOptimizer(
+        rule=rule, lr=1e-3, weight_decay=1e-2, bucket_bytes=bucket_bytes)
+    plan = fused.plan_for(params, mesh)
+    profiler.reset_update_records()
+    opt0 = fused.init_state(params, mesh, plan=plan)
+
+    tx = optax.adamw(1e-3, weight_decay=1e-2) if rule == "adamw" \
+        else optax.sgd(1e-3, momentum=0.9)
+    # Leaf-major optax state in the params' layout (GSPMD-propagated, as
+    # apply_gradients would hold it).
+    oopt0 = jax.jit(tx.init)(params)
+
+    def fused_fn(p, s):
+        new_p, new_s, _ = fused_optim.fused_update_step(
+            fused, p, grads, s, mesh, plan=plan, param_specs=specs)
+        return new_p, new_s
+
+    def optax_fn(p, s):
+        u, s2 = tx.update(grads, s, p)
+        return optax.apply_updates(p, u), s2
+
+    fused_jit = jax.jit(fused_fn, donate_argnums=(0, 1))
+    optax_jit = jax.jit(optax_fn, donate_argnums=(0, 1))
+
+    # Numerics pin before the timed (donating) runs.
+    fp, _ = jax.jit(fused_fn)(params, opt0)
+    op, _ = jax.jit(optax_fn)(params, oopt0)
+    exact = all(np.array_equal(np.asarray(jax.device_get(a)),
+                               np.asarray(jax.device_get(b)))
+                for a, b in zip(jax.tree.leaves(fp), jax.tree.leaves(op)))
+
+    eqns = {
+        "fused": _count_eqns(jax.make_jaxpr(fused_fn)(params, opt0).jaxpr),
+        "optax": _count_eqns(jax.make_jaxpr(optax_fn)(params, oopt0).jaxpr),
+    }
+
+    def timed(step_jit, p, s):
+        def window(carry):
+            p, s = carry
+            for _ in range(steps):
+                p, s = step_jit(p, s)
+            return (p, s), jax.tree.leaves(p)[0].ravel()[0]
+
+        best, _, _ = best_window_time(
+            window, (p, s),
+            params_of=lambda c: jax.tree.leaves(c[0])[0],
+            default_windows=windows)
+        return best / steps
+
+    # Fresh device trees per timed leg: the jitted steps donate their
+    # inputs, so the originals are dead after the first call.
+    host_p = jax.device_get(params)
+    p_f = jax.device_put(host_p, shardings)
+    fused_s = timed(fused_jit, p_f, fused.init_state(p_f, mesh, plan=plan))
+    p_o = jax.device_put(host_p, shardings)
+    optax_s = timed(optax_jit, p_o, jax.jit(tx.init)(p_o))
+    return {
+        "metric": "optim_bench",
+        "rule": rule,
+        "optax_update_s": round(optax_s, 6),
+        "fused_update_s": round(fused_s, 6),
+        "speedup": round(optax_s / fused_s, 4) if fused_s else None,
+        "n_leaves": leaves,
+        "n_buckets": plan.n_buckets,
+        "n_scatter_buckets": plan.n_scatter_buckets,
+        "bucket_nbytes": list(plan.bucket_nbytes),
+        "bucket_threshold": bucket_bytes,
+        "optax_jaxpr_eqns": eqns["optax"],
+        "fused_jaxpr_eqns": eqns["fused"],
+        "numerics_ok": bool(exact),
+        "fsdp": fsdp,
+        "update_records": profiler.update_report(),
+        "backend": jax.default_backend(),
+    }
 
 
 def run_ckpt_bench(*, hidden: int = 2048, steps: int = 4, saves: int = 3,

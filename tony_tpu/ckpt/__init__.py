@@ -30,9 +30,53 @@ shim over this package (orbax no longer required).
 
 from __future__ import annotations
 
+from typing import Any, Callable, List, Optional, Tuple
+
 from tony_tpu.ckpt.format import (FORMAT_VERSION, ChunkReader,
                                   committed_steps, latest_step, prune,
                                   read_manifest, step_dir)
+
+# ---------------------------------------------------------------------------
+# Portable-form codecs: a plane whose LIVE state layout is topology-bound
+# (e.g. the fused optimizer's bucket-resident moment buffers — bucket
+# partitioning depends on the fsdp degree and bucket_bytes) registers an
+# encode/decode pair here so what the manifest records is the PORTABLE
+# form (topology-independent leaf paths/shapes). ``train_loop`` encodes
+# every payload before save and decodes after restore; trees no codec
+# claims pass through untouched, so pre-codec checkpoints and plain optax
+# states behave exactly as before.
+# ---------------------------------------------------------------------------
+
+PORTABLE_CODECS: List[Tuple[str, Callable[[Any], bool],
+                            Callable[[Any], Any],
+                            Callable[[Any, Any], Any]]] = []
+
+
+def register_portable_codec(name: str, predicate: Callable[[Any], bool],
+                            encode: Callable[[Any], Any],
+                            decode: Callable[[Any, Any], Any]) -> None:
+    """Register ``(predicate, encode, decode)`` under ``name`` (replacing
+    an earlier registration of the same name — planes re-import under
+    pytest). ``encode(tree) -> portable tree``; ``decode(tree, mesh) ->
+    live tree`` re-bound to the CURRENT topology."""
+    PORTABLE_CODECS[:] = [c for c in PORTABLE_CODECS if c[0] != name]
+    PORTABLE_CODECS.append((name, predicate, encode, decode))
+
+
+def encode_portable(tree: Any) -> Any:
+    """Apply the first matching codec's encode; identity otherwise."""
+    for _, predicate, encode, _ in PORTABLE_CODECS:
+        if predicate(tree):
+            return encode(tree)
+    return tree
+
+
+def decode_portable(tree: Any, mesh: Optional[Any] = None) -> Any:
+    """Apply the first matching codec's decode; identity otherwise."""
+    for _, predicate, _, decode in PORTABLE_CODECS:
+        if predicate(tree):
+            return decode(tree, mesh)
+    return tree
 
 # snapshot/restore re-exports are LAZY (PEP 562): format is jax-free so
 # the executor's heartbeat can list committed steps without importing the
@@ -46,7 +90,8 @@ _LAZY = {
 
 __all__ = [
     "FORMAT_VERSION", "ChunkReader", "committed_steps", "latest_step",
-    "prune", "read_manifest", "step_dir", *sorted(_LAZY),
+    "prune", "read_manifest", "step_dir", "register_portable_codec",
+    "encode_portable", "decode_portable", *sorted(_LAZY),
 ]
 
 

@@ -11,6 +11,9 @@ reference implementation used for CPU tests and as the autodiff backward.
 from tony_tpu.ops.attention import (
     flash_attention, flash_attention_packed, flash_attention_sharded,
     reference_attention)
+from tony_tpu.ops.fused_optim import (FusedOptimizer, fused_bucket_update,
+                                      fused_update_step)
 
 __all__ = ["flash_attention", "flash_attention_packed",
-           "flash_attention_sharded", "reference_attention"]
+           "flash_attention_sharded", "reference_attention",
+           "FusedOptimizer", "fused_bucket_update", "fused_update_step"]

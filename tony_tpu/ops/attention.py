@@ -66,8 +66,26 @@ def _causal_mask(s, qi, bq, kb, block_k):
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
+def _mask_s(s, qi, bq, kb, block_k, causal, kv_len):
+    """Score masking shared by every kernel body: the causal triangle
+    and/or the key-length mask for end-padded K/V (``kv_len`` = the REAL
+    key count, a static int — ``None`` means no padded keys to hide).
+    Both are resolved at trace time, so the unmasked paths compile to
+    exactly the pre-mask kernels. Padded keys never fully mask a k-block
+    (padding rounds up to the block size, so the last block keeps >= 1
+    real key) — the online-softmax max can't get stuck at -inf."""
+    if causal:
+        s = _causal_mask(s, qi, bq, kb, block_k)
+    if kv_len is not None:
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                        s.shape, 1)
+        s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+    return s
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *, causal: bool, scale: float, qi_axis: int = 1):
+                  acc_scr, *, causal: bool, scale: float, qi_axis: int = 1,
+                  kv_len: Optional[int] = None):
     """Streamed-KV flash forward: grid ``(..., qi, kb)`` with the k-block
     axis INNERMOST, so K/V arrive one ``[Bk, D]`` block at a time (VMEM
     stays O(block), any context length fits) while the online-softmax
@@ -104,8 +122,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         s = jax.lax.dot_general(
             q, k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
-        if causal:
-            s = _causal_mask(s, qi, bq, kb, bk)
+        s = _mask_s(s, qi, bq, kb, bk, causal, kv_len)
         m = m_scr[:, 0:1]
         l = l_scr[:, 0:1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -130,7 +147,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                          dq_scr, *, causal: bool, scale: float,
-                         qi_axis: int = 1):
+                         qi_axis: int = 1, kv_len: Optional[int] = None):
     """dq, streamed like the forward (grid ``(..., qi, kb)``, k innermost,
     dq accumulated in VMEM scratch): recompute p from (q, k, lse) per
     k-block — ds = p·(dpᵀ−D); dq += ds·k·scale. No T×T buffer and no
@@ -157,8 +174,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, qi, bq, kb, bk)
+        s = _mask_s(s, qi, bq, kb, bk, causal, kv_len)
         p = jnp.exp(s - lse)                              # exact softmax
         dp = jax.lax.dot_general(
             do, v_ref[:], (((1,), (1,)), ((), ())),
@@ -175,7 +191,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                          scale: float, qi_axis: int = 1, nqb: int = 0):
+                          scale: float, qi_axis: int = 1, nqb: int = 0,
+                          kv_len: Optional[int] = None):
     """dk/dv, streamed: grid ``(..., kj, qx)`` with the q-side axis
     INNERMOST — q/do/o/lse arrive one block at a time while this k-block's
     dk/dv accumulate in VMEM scratch (dv += pᵀ·do; dk += dsᵀ·q·scale).
@@ -208,8 +225,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, qb, bq, kj, bk)
+        s = _mask_s(s, qb, bq, kj, bk, causal, kv_len)
         p = jnp.exp(s - lse)                              # [Bq, Bk]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -274,7 +290,8 @@ def _lane_of(reps: int):
     return lambda h: h // reps
 
 
-def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret,
+                            kv_len=None):
     b, h, t, d = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     kv_of = _kv_head_of(h, hkv)
@@ -282,7 +299,8 @@ def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret)
     qr = q.reshape(b * h, t, d)
     kr = k.reshape(b * hkv, tk, d)
     vr = v.reshape(b * hkv, tk, d)
-    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               kv_len=kv_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -313,7 +331,7 @@ def _flash_forward_streamed(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, kv_len=None):
     b, h, t, d = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     reps = h // hkv
@@ -331,7 +349,8 @@ def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_
                            lambda g, i, kb: (g, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
+                          kv_len=kv_len),
         grid=(bh, pl.cdiv(t, block_q), pl.cdiv(tk, block_k)),
         in_specs=[q_pin, k_str, k_str, q_pin, q_pin, lse_pin],
         out_specs=q_pin,
@@ -363,7 +382,7 @@ def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
-                          nqb=nqb if reps > 1 else 0),
+                          nqb=nqb if reps > 1 else 0, kv_len=kv_len),
         grid=(b * hkv, pl.cdiv(tk, block_k), reps * nqb),
         in_specs=[q_str, k_pin, k_pin, q_str, q_str, lse_str],
         out_specs=(k_pin, k_pin),
@@ -387,7 +406,8 @@ def _flash_backward_streamed(q, k, v, do, o, lse, causal, scale, block_q, block_
 # --------------------------------------------------------------------
 
 def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  causal: bool, scale: float, qi_axis: int = 1):
+                  causal: bool, scale: float, qi_axis: int = 1,
+                  kv_len: Optional[int] = None):
     """One grid cell: q-block [Bq, D] against the full K/V [T, D] in VMEM,
     streamed in block_k chunks through the online-softmax recurrence. Also
     writes the log-sum-exp rows the backward kernels reconstruct p from.
@@ -423,8 +443,7 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
-        if causal:
-            s = _causal_mask(s, qi, bq, kb, block_k)
+        s = _mask_s(s, qi, bq, kb, block_k, causal, kv_len)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -442,7 +461,7 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                          *, block_k: int, causal: bool, scale: float,
-                         qi_axis: int = 1):
+                         qi_axis: int = 1, kv_len: Optional[int] = None):
     """dq for one q-block: recompute p from (q, k, lse) per k-block —
     ds = p·(dpᵀ−D); dq += ds·k·scale. No T×T buffer ever materializes."""
     bq, d = q_ref.shape
@@ -464,8 +483,7 @@ def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, d
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, qi, bq, kb, block_k)
+        s = _mask_s(s, qi, bq, kb, block_k, causal, kv_len)
         p = jnp.exp(s - lse)                              # exact softmax
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -481,7 +499,8 @@ def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, d
 
 def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
-                          causal: bool, scale: float, qi_axis: int = 1):
+                          causal: bool, scale: float, qi_axis: int = 1,
+                          kv_len: Optional[int] = None):
     """dk/dv for one k-block: iterate q-blocks (from the diagonal down when
     causal): dv += pᵀ·do; dk += dsᵀ·q·scale.
 
@@ -516,8 +535,7 @@ def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, qb, block_q, kj, bk)
+        s = _mask_s(s, qb, block_q, kj, bk, causal, kv_len)
         p = jnp.exp(s - lse)                              # [Bq, Bk]
         dv_new = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -554,7 +572,8 @@ def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret,
+                            kv_len=None):
     b, h, t, d = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     kv_of = _kv_head_of(h, hkv)
@@ -563,7 +582,7 @@ def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret)
     kr = k.reshape(b * hkv, tk, d)
     vr = v.reshape(b * hkv, tk, d)
     kernel = functools.partial(_flash_kernel_resident, block_k=block_k,
-                               causal=causal, scale=scale)
+                               causal=causal, scale=scale, kv_len=kv_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -590,7 +609,7 @@ def _flash_forward_resident(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, kv_len=None):
     b, h, t, d = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     reps = h // hkv
@@ -606,7 +625,7 @@ def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, kv_len=kv_len),
         grid=(bh, pl.cdiv(t, block_q)),
         in_specs=[q_spec, kv_full, kv_full, q_spec, q_spec, lse_blk],
         out_specs=q_spec,
@@ -627,7 +646,7 @@ def _flash_backward_resident(q, k, v, do, o, lse, causal, scale, block_q, block_
     dkv_kernel, dkv_scratch = _dkv_resident_scratch(reps, block_k, d)
     dk, dv = pl.pallas_call(
         functools.partial(dkv_kernel, block_q=block_q,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, kv_len=kv_len),
         grid=(b * hkv, pl.cdiv(tk, block_k), reps),
         in_specs=[q_full, k_spec, k_spec, q_full, q_full, lse_full],
         out_specs=(k_spec, k_spec),
@@ -652,21 +671,22 @@ def _resident_fits(tk: int, d: int, dtype) -> bool:
     return tk * d * jnp.dtype(dtype).itemsize <= _RESIDENT_KV_BYTES
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   kv_len=None):
     if _resident_fits(k.shape[2], k.shape[3], k.dtype):
         return _flash_forward_resident(q, k, v, causal, scale, block_q,
-                                       block_k, interpret)
+                                       block_k, interpret, kv_len)
     return _flash_forward_streamed(q, k, v, causal, scale, block_q,
-                                   block_k, interpret)
+                                   block_k, interpret, kv_len)
 
 
 def _flash_backward(q, k, v, do, o, lse, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, kv_len=None):
     if _resident_fits(k.shape[2], k.shape[3], k.dtype):
         return _flash_backward_resident(q, k, v, do, o, lse, causal, scale,
-                                        block_q, block_k, interpret)
+                                        block_q, block_k, interpret, kv_len)
     return _flash_backward_streamed(q, k, v, do, o, lse, causal, scale,
-                                    block_q, block_k, interpret)
+                                    block_q, block_k, interpret, kv_len)
 
 
 def _flash_forward_packed(q, k, v, heads, causal, scale, block_q, block_k,
@@ -777,23 +797,26 @@ def _flash_backward_packed_resident(q, k, v, do, o, lse, heads, causal, scale,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           kv_len=None):
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, kv_len)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               kv_len=None):
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
+                              interpret, kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, kv_len,
+               residuals, g):
     q, k, v, out, lse = residuals
     return _flash_backward(q, k, v, g, out, lse, causal, scale, block_q,
-                           block_k, interpret)
+                           block_k, interpret, kv_len)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -942,22 +965,28 @@ def _fit_block(limit: int, t: int) -> int:
 def _plan_dispatch(t, tk, block_q, block_k, causal):
     """Shared kernel-dispatch policy for both layouts:
     ``("kernel", bq, bk, None)`` — tile-legal dividing blocks exist;
-    ``("pad", bq, bk, t_pad)`` — causal self-attention, zero-pad the seq;
-    ``("fallback", None, None, reason)`` — ragged non-causal, reference.
+    ``("pad", bq, bk, t_pad)`` — causal self-attention, zero-pad the seq
+    (end-padded keys sit above every real query's diagonal, so the causal
+    mask hides them for free);
+    ``("pad_masked", bq, bk, (t_pad, tk_pad, kv_len))`` — any other
+    ragged lengths (non-causal, or cross q/k): q and K/V zero-pad
+    independently to tile-legal block multiples and the kernels mask the
+    padded keys via the static ``kv_len`` (the BENCH_r02 block-shape
+    constraint used to send these shapes to the reference fallback — the
+    T×T score materialization — instead).
     """
     bq, bk = _fit_block(block_q, t), _fit_block(block_k, tk)
     if bq and bk:
         return ("kernel", bq, bk, None)
-    if not (causal and t == tk):
-        return ("fallback", None, None,
-                f"seq lengths ({t}, {tk}) have no tile-legal blocks and "
-                f"are not causal self-attention")
-    import math
-    t16 = t + ((-t) % 16)
-    bq = min(max(16, block_q - block_q % 16), t16)
-    bk = min(max(16, block_k - block_k % 16), t16)
-    t_pad = t + ((-t) % math.lcm(bq, bk))
-    return ("pad", bq, bk, t_pad)
+    bq = min(max(16, block_q - block_q % 16), t + ((-t) % 16))
+    bk = min(max(16, block_k - block_k % 16), tk + ((-tk) % 16))
+    if causal and t == tk:
+        import math
+        t_pad = t + ((-t) % math.lcm(bq, bk))
+        return ("pad", bq, bk, t_pad)
+    t_pad = t + ((-t) % bq)
+    tk_pad = tk + ((-tk) % bk)
+    return ("pad_masked", bq, bk, (t_pad, tk_pad, tk))
 
 
 def _warn_fallback(reason: str) -> None:
@@ -985,11 +1014,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Dispatch: the pallas kernel on TPU backends (or when ``interpret=True``
     forces the pallas interpreter — how CPU tests cover the kernel), the
-    pure-JAX reference elsewhere. Causal self-attention with a sequence
-    length that doesn't divide the block size is zero-padded up to the next
-    block boundary (end-padded keys sit above the diagonal for every real
-    query, so the causal mask already excludes them); other ragged cases
-    fall back to the reference with a one-time warning.
+    pure-JAX reference elsewhere. Odd shapes stay on the kernel path:
+    causal self-attention with a sequence length that doesn't divide the
+    block size is zero-padded up to the next block boundary (end-padded
+    keys sit above the diagonal for every real query, so the causal mask
+    already excludes them); other ragged seq lengths zero-pad q and K/V
+    independently with the padded keys masked in-kernel (static
+    ``kv_len``); a head_dim off the 8-row sublane tile zero-pads the
+    feature dim (zero k-dims add nothing to scores, zero v-columns are
+    sliced off). The reference only runs on non-TPU backends.
 
     Default blocks are 256: 128² score tiles are MXU-pipeline-latency
     dominated (measured 14.5→9.7 ms per layer fwd+bwd going 128→256 at
@@ -1012,25 +1045,38 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if not on_tpu:
             return reference_attention(q, k, v, causal, scale)
         interpret = False
+    if d % 8:
+        # Head dim off the 8-row sublane tile: zero-pad the feature dim
+        # (extra k dims add 0 to every score; extra v dims emit zero
+        # output columns, sliced off — scale was already computed from
+        # the REAL d above) and stay on the kernel path.
+        widths = ((0, 0), (0, 0), (0, 0), (0, (-d) % 8))
+        return flash_attention(
+            jnp.pad(q, widths), jnp.pad(k, widths), jnp.pad(v, widths),
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            interpret=interpret)[..., :d]
     # Blocks must divide the seq dims AND be sublane-tile-legal: the
     # in-kernel pl.ds(kb*block, block) K/V slices need block to be a
     # multiple of the sublane tile (8 for f32, 16 for bf16 — 16 covers
     # both), else Mosaic rejects the unaligned slice even when the block
     # equals the array dim. _plan_dispatch shrinks to the largest dividing
-    # tile-legal block before resorting to padding or fallback, so e.g.
-    # t=384 runs the kernel unpadded at block 192 rather than padding to
-    # 512; the pad path re-bounds blocks by the padded length so short
-    # sequences don't pay for a full default-sized block (t=8 pads to 16,
-    # not 128).
+    # tile-legal block before resorting to padding, so e.g. t=384 runs
+    # the kernel unpadded at block 192 rather than padding to 512; the
+    # pad paths re-bound blocks by the padded length so short sequences
+    # don't pay for a full default-sized block (t=8 pads to 16, not 128).
     plan, bq, bk, extra = _plan_dispatch(t, tk, block_q, block_k, causal)
     if plan == "kernel":
-        return _flash(q, k, v, causal, scale, bq, bk, interpret)
-    if plan == "fallback":
-        _warn_fallback(extra)
-        return reference_attention(q, k, v, causal, scale)
-    widths = ((0, 0), (0, 0), (0, extra - t), (0, 0))
-    qp, kp, vp = (jnp.pad(x, widths) for x in (q, k, v))
-    out = _flash(qp, kp, vp, causal, scale, bq, bk, interpret)
+        return _flash(q, k, v, causal, scale, bq, bk, interpret, None)
+    if plan == "pad":
+        widths = ((0, 0), (0, 0), (0, extra - t), (0, 0))
+        qp, kp, vp = (jnp.pad(x, widths) for x in (q, k, v))
+        out = _flash(qp, kp, vp, causal, scale, bq, bk, interpret, None)
+        return out[:, :, :t, :]
+    t_pad, tk_pad, kv_len = extra
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+    kvw = ((0, 0), (0, 0), (0, tk_pad - tk), (0, 0))
+    out = _flash(qp, jnp.pad(k, kvw), jnp.pad(v, kvw), causal, scale,
+                 bq, bk, interpret, kv_len if tk_pad != tk else None)
     return out[:, :, :t, :]
 
 
@@ -1084,8 +1130,11 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
     if plan == "kernel":
         return _flash_packed(q, k, v, heads, causal, scale, bq, bk,
                              interpret)
-    if plan == "fallback":
-        _warn_fallback("packed " + extra)
+    if plan == "pad_masked":
+        # Ragged non-causal / cross lengths: route through the classic
+        # layout, whose pad+mask path keeps the pallas kernel (the packed
+        # kernels don't carry the kv mask — one transpose beats a T×T
+        # reference materialization).
         return unpacked_fallback()
     widths = ((0, 0), (0, extra - t), (0, 0))
     qp, kp, vp = (jnp.pad(x, widths) for x in (q, k, v))

@@ -496,6 +496,29 @@ class GradBuckets:
 _record = functools.partial(trace_record, "overlap")
 
 
+def region_param_specs(plan: "GradBuckets", param_specs: Any
+                       ) -> Tuple[Any, List[Tuple[int, ...]]]:
+    """Full-rank shard_map entry specs for a ZeRO-3 plan (shard_map wants
+    one entry per dim). UNEVEN leaves — shard dim not divisible by fsdp,
+    ``plan.shard_pads > 0`` — cross the region boundary REPLICATED:
+    shard_map can't split an indivisible dim, so jax reshards them at
+    entry and their grads exit whole (the scatter bucket still pads and
+    reduces them bandwidth-optimally inside). Returns ``(p_specs,
+    uneven_shapes)`` — shared by the accum engine and the fused-optimizer
+    standalone step so both regions see the identical boundary layout."""
+    spec_leaves = []
+    uneven: List[Tuple[int, ...]] = []
+    for i, s in enumerate(jax.tree.leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P))):
+        entries = list(tuple(s)) + [None] * (len(plan.shapes[i])
+                                             - len(tuple(s)))
+        if plan._pad(i):
+            entries[plan.shard_dims[i]] = None
+            uneven.append(plan.shapes[i])
+        spec_leaves.append(P(*entries))
+    return jax.tree.unflatten(plan.treedef, spec_leaves), uneven
+
+
 def _present(mesh: Mesh, axes: Sequence[str]) -> Tuple[str, ...]:
     """Drop size-1 axes: a psum over them is a no-op the latency-hiding
     scheduler still has to place."""
@@ -511,7 +534,10 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                      param_specs: Optional[Any] = None,
                      hierarchy: str = "auto",
                      gather: str = "bucketed",
-                     prefetch: int = 1):
+                     prefetch: int = 1,
+                     fused: Optional[Any] = None,
+                     opt_slots: Optional[Any] = None,
+                     opt_scal: Optional[jax.Array] = None):
     """Gradient accumulation over ``microbatches`` with per-bucket sync.
 
     ``loss_fn(params, microbatch) -> loss`` (or ``(loss, aux)`` with
@@ -565,6 +591,17 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     microbatch *i+1*'s forward/backward computes (the Horovod overlap,
     expressed for XLA's latency-hiding scheduler — see
     :func:`overlap_xla_flags`).
+
+    **Fused optimizer update** (``fused`` =
+    :class:`tony_tpu.ops.fused_optim.FusedOptimizer`, with ``opt_slots``
+    its bucket-resident slot buffers and ``opt_scal`` the per-step scalar
+    vector): instead of unpacking the reduced bucket buffers into leaf
+    grads, the optimizer update runs IN the region, bucket by bucket, on
+    the very accumulators the scan produced — reduce → update never
+    leaves the bucket domain, and scatter buckets stay in the shard
+    layout throughout. The return changes to ``(loss[, aux], new_params,
+    new_slots, grad_norm)`` where the norm is the bucket-major global
+    grad norm (post-reduce, pre-clip).
     """
     from tony_tpu.parallel import sched as sched_mod  # lazy: no cycle
 
@@ -605,23 +642,7 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         # used to run per gather_params call is gone from the traced
         # path).
         gplan = sched_mod.GatherPlan.from_buckets(plan, prefetch=prefetch)
-        # Full-rank specs: shard_map wants one entry per dim. UNEVEN leaves
-        # (shard dim not divisible by fsdp — plan.shard_pads > 0) cross the
-        # region boundary replicated: shard_map can't split an indivisible
-        # dim, so jax reshards them at entry and their grads exit whole
-        # (the scatter bucket still pads/reduces them bandwidth-optimally
-        # inside).
-        spec_leaves = []
-        uneven = []
-        for i, s in enumerate(jax.tree.leaves(
-                param_specs, is_leaf=lambda x: isinstance(x, P))):
-            entries = list(tuple(s)) + [None] * (len(plan.shapes[i])
-                                                 - len(tuple(s)))
-            if plan._pad(i):
-                entries[plan.shard_dims[i]] = None
-                uneven.append(plan.shapes[i])
-            spec_leaves.append(P(*entries))
-        p_specs = jax.tree.unflatten(plan.treedef, spec_leaves)
+        p_specs, uneven = region_param_specs(plan, param_specs)
         if uneven:
             # Loud on purpose: these leaves lose the ZeRO-3 per-leaf
             # memory saving (replicated at the boundary, whole grads) —
@@ -740,7 +761,7 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                                            tiled=True)
         return jax.tree.unflatten(plan.treedef, leaves)
 
-    def spmd(params, local):
+    def spmd(params, local, slots=None, scal=None):
         mbs = jax.tree.map(
             lambda x: x.reshape((microbatches, x.shape[0] // microbatches)
                                 + x.shape[1:]), local)
@@ -779,6 +800,25 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
 
         (loss, aux, acc), _ = jax.lax.scan(
             body, (jnp.float32(0.0), jnp.float32(0.0), acc0), mbs)
+        denom = microbatches * group
+        if fused is not None:
+            # Fused-optimizer tail: mean-scale the bucket accumulators
+            # ("rs" buckets re-gather once first — their leaves live
+            # replicated) and hand them STRAIGHT to the in-region update;
+            # the leaf-grad pytree never materializes.
+            g_bufs = []
+            for b, (a, n) in enumerate(zip(acc, plan.bucket_numel)):
+                if sched[b][0] == "rs":
+                    a = jax.lax.all_gather(a, rs_axes, tiled=True)[:n]
+                g_bufs.append(a / denom)
+            new_leaves, new_slots, gnorm = fused.region_apply(
+                plan, jax.tree.leaves(params), g_bufs, slots, scal,
+                sharded=zero3 and plan.shard_size > 1)
+            loss = jax.lax.psum(loss, axes) / denom
+            aux = jax.lax.psum(aux, axes) / denom
+            return (loss, aux,
+                    jax.tree.unflatten(plan.treedef, new_leaves),
+                    new_slots, gnorm)
         # Tail: "rs" buckets re-gather ONCE over their scatter group;
         # even scatter buckets stay in the shard layout (that IS the
         # output); PADDED scatter buckets re-gather over fsdp and unpad —
@@ -798,13 +838,30 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                 parts = plan.leaf_buffers(b, a, layout="full")
             for i, v in parts.items():
                 leaf_out[i] = v
-        denom = microbatches * group
         tree = jax.tree.unflatten(plan.treedef, leaf_out)
         grads = jax.tree.map(lambda b: b / denom, tree)
         loss = jax.lax.psum(loss, axes) / denom
         aux = jax.lax.psum(aux, axes) / denom
         return loss, aux, grads
 
+    if fused is not None:
+        if opt_slots is None or opt_scal is None:
+            raise ValueError(
+                "microbatch_grads(fused=...) needs opt_slots (the bucket-"
+                "resident slot buffers) and opt_scal (FusedOptimizer"
+                ".scalars(count))")
+        fused.check_slots(plan, opt_slots)
+        bspecs_f = fused.bucket_specs(plan)
+        slot_specs = {n: list(bspecs_f) for n in fused.slot_names}
+        fused.record("accum_update", plan, microbatches=microbatches)
+        loss, aux, new_params, new_slots, gnorm = compat.shard_map(
+            spmd, mesh,
+            in_specs=(p_specs, b_specs, slot_specs, P()),
+            out_specs=(P(), P(), p_specs, slot_specs, P()))(
+                params, batch, opt_slots, opt_scal)
+        if has_aux:
+            return loss, aux, new_params, new_slots, gnorm
+        return loss, new_params, new_slots, gnorm
     loss, aux, grads = compat.shard_map(
         spmd, mesh, in_specs=(p_specs, b_specs),
         out_specs=(P(), P(), p_specs))(params, batch)

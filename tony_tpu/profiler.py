@@ -169,6 +169,34 @@ def reset_input_records() -> None:
     INPUT_RECORDS.clear()
 
 
+# ---------------------------------------------------------------------------
+# Fused-optimizer instrumentation (tony_tpu.ops.fused_optim): the update
+# plane records, at trace time, the bucket-major update schedule — bucket
+# count and per-bucket payload bytes, which kernel path ran (pallas vs the
+# pure-XLA fallback), the rule and its slot layout — keyed by tag
+# ("accum_update" from the in-region accum path, "fused_update" from the
+# standalone step); last plan per tag wins. run_optim_bench serializes
+# this next to the overlap records so "one launch per bucket" is an
+# inspectable number, not a design claim.
+UPDATE_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_update(tag: str, /, **fields) -> None:
+    """Bank one fused-optimizer update record (rule, impl, bucket count &
+    bytes, slot layout, clip/decay config...)."""
+    UPDATE_RECORDS[tag] = dict(fields)
+
+
+def update_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded update schedule (deep-copied via
+    :func:`_snapshot` — same aliasing contract as the other reports)."""
+    return _snapshot(UPDATE_RECORDS)
+
+
+def reset_update_records() -> None:
+    UPDATE_RECORDS.clear()
+
+
 # One guarded entry point for the trace-side recorders (overlap grad sync,
 # ckpt snapshot, input prefetch): bookkeeping must never sink a step or a
 # save, and a broken wiring is logged once per registry at DEBUG — not per
@@ -178,10 +206,11 @@ _SAFE_RECORD_FAILED: set = set()
 
 def safe_record(kind: str, tag: str, /, **fields) -> None:
     """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
-    ``"input"``/``"collective"``), swallowing any failure."""
+    ``"input"``/``"collective"``/``"update"``), swallowing any failure."""
     try:
         {"overlap": record_overlap, "ckpt": record_ckpt,
-         "input": record_input, "collective": record_collective}[kind](
+         "input": record_input, "collective": record_collective,
+         "update": record_update}[kind](
              tag, **fields)
     except Exception:  # noqa: BLE001
         if kind not in _SAFE_RECORD_FAILED:

@@ -103,15 +103,28 @@ def param_shardings(model: nn.Module, sample_input: jax.Array, mesh: Mesh,
     return abstract["params"], shardings["params"]
 
 
-def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
+def create_train_state(model: nn.Module, tx: Any,
                        sample_input: jax.Array, rng: jax.Array,
                        mesh: Optional[Mesh] = None,
                        rules=par.RULES) -> TrainState:
     """Initialize a TrainState; with a mesh, params are created already
     sharded (jit + constraints — no host-memory detour) and the optimizer
-    state inherits the layout via GSPMD propagation."""
+    state inherits the layout via GSPMD propagation.
+
+    ``tx`` may be an optax ``GradientTransformation`` (leaf-major state,
+    the default path) or a :class:`tony_tpu.ops.fused_optim
+    .FusedOptimizer` — then the optimizer state is **bucket-resident**:
+    per-bucket f32 moment buffers in the ZeRO-3 scatter layout, planned
+    from the params' committed shardings, consumed in place by
+    ``make_accum_train_step(update="fused_bucket")``."""
+    from tony_tpu.ops import fused_optim
+
+    fused = isinstance(tx, fused_optim.FusedOptimizer)
     if mesh is None:
         params = nn.unbox(model.init(rng, sample_input))["params"]
+        if fused:
+            return TrainState(step=0, apply_fn=model.apply, params=params,
+                              tx=tx, opt_state=tx.init_state(params))
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
     _, shardings = param_shardings(model, sample_input, mesh, rng, rules)
 
@@ -120,10 +133,18 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
             params = nn.unbox(model.init(rng, sample_input))["params"]
         params = jax.tree.map(jax.lax.with_sharding_constraint,
                               params, shardings)
+        if fused:
+            return params
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
     with mesh_context(mesh):
-        return jax.jit(make)(rng)
+        out = jax.jit(make)(rng)
+    if not fused:
+        return out
+    # Bucket planning reads COMMITTED shardings, so the opt state is
+    # built eagerly from the real (already-sharded) params.
+    return TrainState(step=0, apply_fn=model.apply, params=out, tx=tx,
+                      opt_state=tx.init_state(out, mesh))
 
 
 def make_train_step(loss_of: Callable[[jax.Array, Dict[str, jax.Array]],
@@ -201,6 +222,7 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                           hierarchy: str = "auto",
                           gather: str = "bucketed",
                           prefetch: int = 1,
+                          update: str = "optax",
                           donate: bool = True,
                           apply_kwargs_of: Optional[Callable[
                               [Dict[str, jax.Array]],
@@ -235,10 +257,26 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     per-bucket DCN allreduce inside the scan (``hierarchy="flat"`` forces
     the single-level reduce — the numerics pin). The model must be
     collective-free inside (same contract as ``gpipe``'s ``stage_fn``).
+
+    ``update`` selects the optimizer path: ``"optax"`` (default — the
+    reduced grads unpack to leaves and ``state.apply_gradients`` runs
+    optax's per-leaf update) or ``"fused_bucket"`` — the state's ``tx``
+    must be a :class:`tony_tpu.ops.fused_optim.FusedOptimizer` and its
+    opt state bucket-resident (``create_train_state`` builds it): the
+    update then runs INSIDE the accum region as one fused kernel per
+    bucket buffer, straight off the scan's reduce accumulators — grads
+    never re-materialize as a leaf pytree, scatter buckets never leave
+    the shard layout, and the reported ``grad_norm`` is the bucket-major
+    fused reduction (per-leaf value up to fp reassociation). The bucket
+    plan is the tx's (``bucket_bytes`` on the FusedOptimizer — the
+    ``bucket_bytes`` argument here must agree, it sized the opt state).
     """
     if mesh is None:
         raise ValueError("make_accum_train_step requires a mesh: the "
                          "bucketed reduction IS the cross-device sync")
+    if update not in ("optax", "fused_bucket"):
+        raise ValueError(f"unknown update mode {update!r} "
+                         "(optax|fused_bucket)")
     if loss_of is None:
         loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
 
@@ -255,6 +293,29 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                            jax.tree.leaves(sown.get("losses", {}))),
                           start=jnp.float32(0.0))
                 return loss_of(logits, mb) + aux, aux
+
+            if update == "fused_bucket":
+                # Bucket-major end to end: the optimizer update runs in
+                # the accum region on the scan's reduce accumulators —
+                # one fused kernel per bucket, grad norm included.
+                count_inc = state.opt_state["count"] + 1
+                scal = state.tx.scalars(count_inc)
+                loss, aux, new_params, new_slots, gnorm = \
+                    overlap.microbatch_grads(
+                        loss_fn, state.params, batch, mesh,
+                        microbatches=microbatches,
+                        bucket_bytes=state.tx.bucket_bytes,
+                        reduce_op=reduce_op, has_aux=True,
+                        param_specs=param_specs, hierarchy=hierarchy,
+                        gather=gather, prefetch=prefetch,
+                        fused=state.tx,
+                        opt_slots=state.opt_state["slots"],
+                        opt_scal=scal)
+                new_state = state.replace(
+                    step=state.step + 1, params=new_params,
+                    opt_state={"count": count_inc, "slots": new_slots})
+                return new_state, {"loss": loss, "grad_norm": gnorm,
+                                   "aux_loss": aux}
 
             loss, aux, grads = overlap.microbatch_grads(
                 loss_fn, state.params, batch, mesh,
@@ -279,6 +340,21 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     jitted: Dict[Any, Any] = {}
 
     def stepper(state, batch):
+        if update == "fused_bucket":
+            from tony_tpu.ops import fused_optim
+
+            if not isinstance(state.tx, fused_optim.FusedOptimizer):
+                raise ValueError(
+                    "update='fused_bucket' needs a state whose tx is a "
+                    "tony_tpu.ops.fused_optim.FusedOptimizer (build it "
+                    f"with create_train_state), got {type(state.tx)}")
+            if bucket_bytes != overlap.DEFAULT_BUCKET_BYTES \
+                    and bucket_bytes != state.tx.bucket_bytes:
+                raise ValueError(
+                    f"update='fused_bucket': bucket_bytes={bucket_bytes} "
+                    f"disagrees with the FusedOptimizer's "
+                    f"{state.tx.bucket_bytes} — the tx's value sized the "
+                    f"bucket-resident opt state and wins; set it there")
         leaves, treedef = jax.tree.flatten(state.params)
         key = (treedef,
                tuple(getattr(l, "sharding", None) for l in leaves))
@@ -368,9 +444,15 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                 # must still get the model (the strict-mode tree-mismatch
                 # KeyError it would otherwise hit reads like a wrong
                 # model, not a wrapped checkpoint).
-                state = ckpt_mod.restore_pytree(
-                    ckpt_dir, {ckptio.MODEL_KEY: state}, step=latest,
-                    mesh=mesh)[ckptio.MODEL_KEY]
+                # encode/decode_portable: planes with topology-bound live
+                # state (the fused optimizer's bucket-resident moments)
+                # restore through their portable leaf-major form and are
+                # re-bound to THIS attempt's topology; identity for
+                # everything else.
+                state = ckpt_mod.decode_portable(ckpt_mod.restore_pytree(
+                    ckpt_dir,
+                    {ckptio.MODEL_KEY: ckpt_mod.encode_portable(state)},
+                    step=latest, mesh=mesh)[ckptio.MODEL_KEY], mesh)
                 if stateful_data:
                     data.restore(ckptio.load_iter_state(ckpt_dir, latest))
                 else:
@@ -380,12 +462,19 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                         "model resumes, the input stream starts from the "
                         "beginning", latest)
             else:
-                state = ckpt_mod.restore_latest(ckpt_dir, state, mesh=mesh)
+                state = ckpt_mod.decode_portable(
+                    ckpt_mod.restore_latest(
+                        ckpt_dir, ckpt_mod.encode_portable(state),
+                        mesh=mesh), mesh)
 
     def payload():
+        # Saves go through the same portable codec: manifests carry the
+        # topology-independent form (fused opt state leaf-major), so any
+        # future attempt's topology can restore them.
+        st = ckpt_mod.encode_portable(state)
         if stateful_data:
-            return ckptio.wrap_for_save(state, data.state())
-        return state
+            return ckptio.wrap_for_save(st, data.state())
+        return st
 
     metrics: Dict[str, Any] = {}
     done = 0
