@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-slow quick test
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-analysis tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-analysis
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -51,6 +51,18 @@ tier1-sched:
 # changed fsdp topologies.
 tier1-optim:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'optim and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Static-analysis marker leg (also inside tier1-verify's selection) — the
+# jaxpr invariant analyzer: shipped configs clean, every rule fires on a
+# seeded violation, committed step-signature pins, source lint.
+tier1-analysis:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'analysis and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# The jnp.concatenate/stack pack-site lint (the jax-0.4 GSPMD concat-
+# reshard footgun, machine-checked): every call site outside the approved
+# pack planes must carry an audited 'packsite: region-local' pragma.
+lint:
+	python -m tony_tpu.analysis.srclint tony_tpu
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

@@ -45,6 +45,7 @@ _TIER_BY_MODULE = {
     "test_moe": "jit", "test_batchnorm": "jit", "test_parallel": "jit",
     "test_pipeline": "jit", "test_overlap": "jit", "test_multislice": "jit",
     "test_sched": "jit",
+    "test_analysis": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

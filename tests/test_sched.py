@@ -271,6 +271,8 @@ class TestReportAliasing:
         ("collective", profiler.collective_report,
          profiler.reset_collective_records),
         ("update", profiler.update_report, profiler.reset_update_records),
+        ("analysis", profiler.analysis_report,
+         profiler.reset_analysis_records),
     ])
     def test_mutating_report_does_not_poison_store(self, kind, report,
                                                    reset):

@@ -1,0 +1,390 @@
+"""The analyzer's rule suite: every invariant the training stack claims by
+construction, re-checked against the traced program.
+
+The rules run over (a) the step's closed jaxpr, (b) the planner artifacts
+that made the claims (:class:`~tony_tpu.parallel.overlap.GradBuckets`,
+:class:`~tony_tpu.parallel.sched.GatherPlan`, the shared
+:func:`~tony_tpu.parallel.overlap.reduce_schedule`), and (c) the traced
+function's donation metadata. Findings are structured records — rule,
+kind, severity, message, equation provenance, byte cost — so the CI gate
+can diff them and the waiver mechanism can address them individually.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.analysis import jaxprwalk as jw
+from tony_tpu.parallel import FSDP
+
+# Collectives at/below this payload are bookkeeping scalars (loss/aux
+# means, grad-norm psums) — enumerated but auto-accepted by the audit, so
+# the planned set stays about the transfers that cost bandwidth.
+SCALAR_NBYTES = 256
+
+RULE_NAMES: Tuple[str, ...] = (
+    "replication_leak", "collective_audit", "dtype_policy", "donation",
+    "signature")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation (or acceptance-worthy observation)."""
+
+    rule: str          # one of RULE_NAMES
+    kind: str          # specific finding kind within the rule
+    severity: str      # "error" | "warning"
+    message: str
+    provenance: str = ""
+    nbytes: int = 0
+    waived: bool = False
+    waived_by: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "kind": self.kind,
+                "severity": self.severity, "message": self.message,
+                "provenance": self.provenance, "nbytes": self.nbytes,
+                "waived": self.waived, "waived_by": self.waived_by}
+
+
+@dataclass(frozen=True)
+class Waiver:
+    """Accept a known finding: matches when ``rule`` equals the finding's
+    rule (or ``"*"``) and ``match`` is a substring of its message or
+    provenance. ``reason`` is recorded on the waived finding — a waiver
+    without a reason is a suppression, not an acceptance."""
+
+    rule: str
+    match: str
+    reason: str
+
+
+def apply_waivers(findings: Sequence[Finding], waivers: Sequence[Waiver]
+                  ) -> Tuple[List[Finding], List[Finding]]:
+    """Split ``findings`` into (active, waived)."""
+    active: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        hit = next(
+            (w for w in waivers
+             if w.rule in ("*", f.rule)
+             and (w.match in f.message or w.match in f.provenance)),
+            None)
+        if hit is None:
+            active.append(f)
+        else:
+            waived.append(replace(f, waived=True, waived_by=hit.reason))
+    return active, waived
+
+
+# ---------------------------------------------------------------------------
+# The planned-collective set (rules 1 + 2 audit the jaxpr against this)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Expected:
+    """One planned collective-equation shape: ``count`` static equation
+    occurrences of ``kind`` over ``axes`` moving ``nbytes`` each."""
+
+    kind: str
+    axes: frozenset
+    nbytes: int
+    count: int
+    plane: str
+    note: str = ""
+
+
+def _add(exp: List[Expected], kind: str, axes: Sequence[str], nbytes: int,
+         plane: str, note: str = "") -> None:
+    key = frozenset(axes)
+    for e in exp:
+        if (e.kind, e.axes, e.nbytes, e.plane) == (kind, key, nbytes,
+                                                   plane):
+            e.count += 1
+            return
+    exp.append(Expected(kind, key, int(nbytes), 1, plane, note))
+
+
+def expected_accum_collectives(plan: Any, gplan: Optional[Any], mesh: Any,
+                               *, gather: str = "bucketed",
+                               reduce_op: str = "all_reduce",
+                               hierarchy: str = "auto",
+                               update: str = "optax",
+                               fused: Optional[Any] = None
+                               ) -> List[Expected]:
+    """The full planned-collective multiset of one
+    ``make_accum_train_step`` trace, derived from the SAME planner
+    artifacts the engine executes (``reduce_schedule`` is shared code, so
+    the audit can't drift from the step): forward gathers (bucketed or
+    per-leaf), the per-bucket reduce schedule with its post-scatter psum
+    groups, the tail re-gathers, and — for the fused-optimizer path — the
+    update plane's own param re-gathers."""
+    from tony_tpu.parallel import overlap
+
+    exp: List[Expected] = []
+    zero3 = gplan is not None and plan.shard_size > 1
+    if zero3:
+        if gather == "bucketed":
+            for b in gplan.gather_buckets:
+                _add(exp, "all_gather", (gplan.axis,),
+                     plan.bucket_nbytes[b], "fwd_gather", f"bucket {b}")
+        else:
+            for i, _d in gplan.gather_leaves:
+                nb = int(np.prod(plan.shapes[i], dtype=np.int64)) \
+                    * plan.dtypes[i].itemsize
+                _add(exp, "all_gather", (gplan.axis,), nb, "fwd_gather",
+                     f"leaf {i}")
+    sched, rs_axes, rs_group, hier = overlap.reduce_schedule(
+        plan, mesh, reduce_op=reduce_op, hierarchy=hierarchy)
+    axes = overlap.sync_axes(mesh)
+    for b, (mode, post) in enumerate(sched):
+        nb = plan.bucket_nbytes[b]
+        item = plan.dtypes[plan.buckets[b][0]].itemsize
+        if mode == "scatter":
+            chunk = nb // plan.shard_size
+            _add(exp, "reduce_scatter", (FSDP,), chunk, "grad_reduce",
+                 f"bucket {b}")
+            for g in post:
+                _add(exp, "psum", g, chunk, "grad_reduce",
+                     f"bucket {b} post")
+        elif mode == "rs":
+            numel = plan.bucket_numel[b]
+            padded = numel + ((-numel) % rs_group)
+            chunk = (padded // rs_group) * item
+            _add(exp, "reduce_scatter", rs_axes, chunk, "grad_reduce",
+                 f"bucket {b}")
+            for g in post:
+                _add(exp, "psum", g, chunk, "grad_reduce",
+                     f"bucket {b} post")
+            # Both the optax tail and the fused tail re-gather "rs"
+            # buckets once (their leaves live replicated).
+            _add(exp, "all_gather", rs_axes, padded * item, "grad_reduce",
+                 f"bucket {b} tail re-gather")
+        else:
+            _add(exp, "psum", axes, nb, "grad_reduce", f"bucket {b}")
+    for b in range(plan.n_buckets):
+        if plan._is_scatter(b) and plan._is_padded(b) \
+                and update != "fused_bucket":
+            # Padded (uneven-leaf) scatter buckets re-gather over fsdp
+            # after the scan so their grads exit whole.
+            _add(exp, "all_gather", (FSDP,), plan.bucket_nbytes[b],
+                 "grad_reduce", f"bucket {b} padded tail re-gather")
+    if update == "fused_bucket" and fused is not None:
+        for kind, caxes, nb, note in fused.region_collectives(
+                plan, sharded=zero3):
+            _add(exp, kind, caxes, nb, "param_update", note)
+    return exp
+
+
+# ---------------------------------------------------------------------------
+# Rules 1 + 2: replication-leak + collective audit
+# ---------------------------------------------------------------------------
+
+def reconcile_collectives(collectives: Sequence[jw.CollectiveEqn],
+                          expected: Sequence[Expected], *,
+                          scalar_nbytes: int = SCALAR_NBYTES
+                          ) -> List[Finding]:
+    """Match every collective equation against the planned multiset.
+
+    Unmatched big equations become findings: an ``all_gather`` over the
+    fsdp axis is a **replication leak** (it materializes a full
+    fsdp-sharded buffer the prefetch window never planned — the ZeRO-3
+    memory contract breaks exactly here); anything else is an **unplanned
+    collective** (the GSPMD partitioner or a model edit inserted traffic
+    the scheduler doesn't own). Planned-but-missing entries above the
+    scalar threshold are reported too — a silently vanished collective
+    usually means the audit is looking at a stale plan."""
+    findings: List[Finding] = []
+    pool = [Expected(e.kind, e.axes, e.nbytes, e.count, e.plane, e.note)
+            for e in expected]
+    for c in collectives:
+        hit = next(
+            (e for e in pool
+             if e.count > 0 and e.kind == c.kind
+             and e.axes == frozenset(c.axes) and e.nbytes == c.nbytes),
+            None)
+        if hit is not None:
+            hit.count -= 1
+            continue
+        if c.nbytes <= scalar_nbytes:
+            continue                      # bookkeeping scalar — accepted
+        if c.kind == "all_gather" and FSDP in c.axes:
+            findings.append(Finding(
+                rule="replication_leak", kind="unplanned_gather",
+                severity="error",
+                message=(f"all_gather over {list(c.axes)} materializes "
+                         f"{c.nbytes} B of fsdp-sharded state outside "
+                         f"the planned prefetch live window"),
+                provenance=c.provenance, nbytes=c.nbytes))
+        else:
+            findings.append(Finding(
+                rule="collective_audit", kind="unplanned_collective",
+                severity="error",
+                message=(f"{c.kind} over {list(c.axes)} moving "
+                         f"{c.nbytes} B is not in the planner's "
+                         f"collective set (GSPMD-inserted reshard or "
+                         f"unregistered plane?)"),
+                provenance=c.provenance, nbytes=c.nbytes))
+    for e in pool:
+        if e.count > 0 and e.nbytes > scalar_nbytes:
+            findings.append(Finding(
+                rule="collective_audit", kind="planned_missing",
+                severity="error",
+                message=(f"planned {e.kind} over {sorted(e.axes)} "
+                         f"({e.nbytes} B x{e.count}, plane {e.plane}"
+                         f"{', ' + e.note if e.note else ''}) never "
+                         f"appears in the traced step — stale plan or "
+                         f"dropped collective"),
+                nbytes=e.nbytes * e.count))
+    return findings
+
+
+def check_prefetch_chain(closed: Any, gplan: Optional[Any],
+                         gather: str) -> List[Finding]:
+    """Rule 1's structural half: a bucketed gather plan with
+    ``prefetch > 0`` promises bucket *k* waits on bucket *k − prefetch*
+    via an ``optimization_barrier`` chain. If the barriers are gone (a
+    refactor dropped them), every gather may hoist to step start and the
+    whole replicated working set materializes at once — exactly the leak
+    the window bounds."""
+    if gplan is None or gather != "bucketed" or not gplan.prefetch:
+        return []
+    need = max(0, gplan.n_gather_buckets - gplan.prefetch)
+    if not need:
+        return []
+    have = jw.prim_counts(closed).get("optimization_barrier", 0)
+    if have >= need:
+        return []
+    return [Finding(
+        rule="replication_leak", kind="prefetch_chain_broken",
+        severity="error",
+        message=(f"gather plan promises a prefetch={gplan.prefetch} "
+                 f"barrier chain over {gplan.n_gather_buckets} buckets "
+                 f"({need} optimization_barrier eqns) but the trace has "
+                 f"{have} — gathers can hoist past the live window "
+                 f"(window {gplan.window_nbytes()} B, total "
+                 f"{sum(gplan.gather_nbytes)} B)"),
+        nbytes=sum(gplan.gather_nbytes))]
+
+
+# ---------------------------------------------------------------------------
+# Rule 3: dtype policy
+# ---------------------------------------------------------------------------
+
+# Equations that ACCUMULATE: a low-precision output here loses gradient
+# mass silently (bf16 has 8 mantissa bits; summing K terms loses ~log2 K
+# of them). Matmuls are deliberately absent — bf16 on the MXU with f32
+# accumulation is the intended fast path.
+_REDUCTION_PRIMS = ("reduce_sum", "psum", "reduce_scatter", "add_any",
+                    "cumsum")
+_LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+
+def dtype_findings(closed: Any) -> List[Finding]:
+    """f64 must never appear (a silent promotion doubles every byte count
+    the planner budgeted) and bf16/f16 must never be the carry dtype of a
+    reduction."""
+    out: List[Finding] = []
+    for path, i, eqn in jw.iter_eqns(closed):
+        prov = ""
+        for v in eqn.outvars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is None:
+                continue
+            if dt == jnp.float64:
+                prov = prov or jw.CollectiveEqn(
+                    eqn.primitive.name, (), jw.eqn_out_nbytes(eqn), path,
+                    i, jw.source_of(eqn)).provenance
+                out.append(Finding(
+                    rule="dtype_policy", kind="f64_promotion",
+                    severity="error",
+                    message=(f"{eqn.primitive.name} produces float64 — "
+                             f"silent f64 promotion doubles bandwidth "
+                             f"and memory against every plan"),
+                    provenance=prov, nbytes=jw.eqn_out_nbytes(eqn)))
+                break
+        if eqn.primitive.name in _REDUCTION_PRIMS:
+            for v in eqn.outvars:
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None and any(dt == lp
+                                          for lp in _LOW_PRECISION):
+                    out.append(Finding(
+                        rule="dtype_policy", kind="low_precision_reduction",
+                        severity="error",
+                        message=(f"{eqn.primitive.name} accumulates in "
+                                 f"{np.dtype(dt).name} — reductions must "
+                                 f"carry f32 (bf16 never accumulates)"),
+                        provenance=jw.CollectiveEqn(
+                            eqn.primitive.name, jw.eqn_axes(eqn),
+                            jw.eqn_out_nbytes(eqn), path, i,
+                            jw.source_of(eqn)).provenance,
+                        nbytes=jw.eqn_out_nbytes(eqn)))
+                    break
+    return out
+
+
+def opt_state_findings(state: Any) -> List[Finding]:
+    """The fused plane's bucket-resident moment slots must be f32 — the
+    whole point of keeping our own slots instead of optax's
+    param-dtype-following moments (bf16 params would otherwise get bf16
+    Adam variance, which underflows at small grads)."""
+    from tony_tpu.ops import fused_optim
+
+    out: List[Finding] = []
+    if not fused_optim.is_fused_state(state):
+        return out
+    for name, bufs in state.opt_state.get("slots", {}).items():
+        for b, buf in enumerate(bufs):
+            dt = getattr(buf, "dtype", None)
+            if dt is not None and dt != jnp.float32:
+                out.append(Finding(
+                    rule="dtype_policy", kind="non_f32_moments",
+                    severity="error",
+                    message=(f"moment slot {name!r} bucket {b} is "
+                             f"{np.dtype(dt).name}, policy requires "
+                             f"float32"),
+                    provenance=f"opt_state.slots[{name!r}][{b}]",
+                    nbytes=jw.aval_nbytes(buf)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: donation
+# ---------------------------------------------------------------------------
+
+def donation_findings(traced: Any, args: Sequence[Any],
+                      arg_names: Sequence[str],
+                      expect_donated: Sequence[int] = (0,)
+                      ) -> List[Finding]:
+    """Every argument in ``expect_donated`` (the state: params, bucket
+    accumulator seeds, opt-state slots) must be donated to the jit — an
+    undonated state doubles its residency, because XLA cannot alias the
+    update into the input buffers. The finding names the argument and
+    its byte cost, biggest leaf first."""
+    donated = tuple(getattr(traced, "donate_argnums", ()) or ())
+    out: List[Finding] = []
+    for argnum in expect_donated:
+        if argnum in donated or argnum >= len(args):
+            continue
+        flat = jax.tree_util.tree_flatten_with_path(args[argnum])[0]
+        sized = sorted(((jw.aval_nbytes(leaf), path)
+                        for path, leaf in flat), reverse=True,
+                       key=lambda t: t[0])
+        total = sum(nb for nb, _ in sized)
+        top = ", ".join(
+            f"{jax.tree_util.keystr(path)}={nb}B"
+            for nb, path in sized[:3])
+        name = arg_names[argnum] if argnum < len(arg_names) \
+            else f"arg{argnum}"
+        out.append(Finding(
+            rule="donation", kind="undonated_argument", severity="error",
+            message=(f"argument {argnum} ({name!r}, {total} B) is not "
+                     f"donated — XLA cannot alias the updated state into "
+                     f"its input buffers (largest leaves: {top})"),
+            provenance=f"donate_argnums={donated}", nbytes=total))
+    return out

@@ -1,0 +1,174 @@
+"""Source lint for the jax-0.4 GSPMD concat footgun (``make lint``).
+
+The bug this machine-checks: jax 0.4.x's partitioner mis-reshards
+concatenated slices of sharded arrays on multi-axis meshes (measured 2×
+values in PR 7 — Adam's scale invariance masked it for a whole bench
+round). The stack's rule since then: ``jnp.concatenate``/``jnp.stack``
+over potentially-sharded inputs happens ONLY at the approved
+region-local pack sites (``parallel/overlap.py``, ``ops/fused_optim.py``,
+the ``ckpt`` codec) or through host numpy. A static check can't see
+shardings, so the lint is conservative: every ``jnp.concatenate`` /
+``jnp.stack`` call outside the approved files is flagged unless the call
+line — or the contiguous comment block immediately above it — carries
+the audit pragma::
+
+    # packsite: region-local — <why this site is safe>
+
+Host ``np.concatenate`` is never flagged — that IS the sanctioned detour.
+
+Run directly (``python -m tony_tpu.analysis.srclint [paths...]``) or via
+``make lint`` / ``tony analyze --lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Sequence, Tuple
+
+PRAGMA = "packsite: region-local"
+
+# Whole files whose packing IS the approved implementation (the planner's
+# shard-major pack, the fused plane's local pack, the ckpt codec).
+ALLOWED_FILES: Tuple[str, ...] = ("parallel/overlap.py",
+                                  "ops/fused_optim.py")
+ALLOWED_DIRS: Tuple[str, ...] = ("ckpt/",)
+
+_BANNED_ATTRS = ("concatenate", "stack")
+
+
+@dataclass(frozen=True)
+class LintViolation:
+    path: str
+    line: int
+    col: int
+    call: str
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.call} "
+                f"outside an approved pack site — jax-0.4 GSPMD "
+                f"mis-reshards concatenated slices of sharded arrays on "
+                f"multi-axis meshes; pack region-locally (inside the "
+                f"shard_map region) or via host numpy, or bless an "
+                f"audited site with '# {PRAGMA} — <why>'")
+
+
+def _is_jnp_call(node: ast.Call) -> str:
+    """``"jnp.concatenate"``-style name when the call is a banned jax
+    numpy op, else ``""``. Matches ``jnp.<op>`` and ``jax.numpy.<op>``
+    (the two spellings the codebase uses); host ``np.<op>`` passes."""
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _BANNED_ATTRS:
+        return ""
+    recv = func.value
+    if isinstance(recv, ast.Name) and recv.id == "jnp":
+        return f"jnp.{func.attr}"
+    if isinstance(recv, ast.Attribute) and recv.attr == "numpy" \
+            and isinstance(recv.value, ast.Name) and recv.value.id == "jax":
+        return f"jax.numpy.{func.attr}"
+    return ""
+
+
+def _allowed(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return rel in ALLOWED_FILES or any(rel.startswith(d)
+                                       for d in ALLOWED_DIRS)
+
+
+def lint_source(src: str, rel: str, display_path: str
+                ) -> List[LintViolation]:
+    """Lint one file's source text (``rel`` is the package-relative path
+    the allowlist matches against)."""
+    if _allowed(rel):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [LintViolation(display_path, e.lineno or 0, 0,
+                              "unparseable file")]
+    lines = src.splitlines()
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        call = _is_jnp_call(node)
+        if not call:
+            continue
+        # Blessed when the pragma sits on the call's own line(s) or in
+        # the CONTIGUOUS comment block immediately above it. Anchoring at
+        # the call matters: a window of N lines below the pragma would
+        # let an unaudited call stacked right after an audited one pass.
+        start = node.lineno - 1
+        end = min(len(lines), getattr(node, "end_lineno", node.lineno))
+        blessed = any(PRAGMA in lines[i] for i in range(start, end))
+        i = start - 1
+        while not blessed and i >= 0 and lines[i].lstrip().startswith("#"):
+            blessed = PRAGMA in lines[i]
+            i -= 1
+        if not blessed:
+            out.append(LintViolation(display_path, node.lineno,
+                                     node.col_offset, call))
+    return out
+
+
+def _package_rel(path: Path, root: Path) -> str:
+    """The path the allowlist matches against: relative to the nearest
+    enclosing ``tony_tpu`` package dir, however the linter was invoked
+    (whole tree, a subdirectory, or one explicit file) — else relative
+    to ``root`` (temp trees in tests)."""
+    parts = path.resolve().parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == "tony_tpu":
+            return "/".join(parts[i + 1:])
+    try:
+        return str(path.resolve().relative_to(root.resolve()))
+    except ValueError:
+        return path.name
+
+
+def lint_file(path: Path, root: Path) -> List[LintViolation]:
+    return lint_source(path.read_text(), _package_rel(path, root),
+                       str(path))
+
+
+def default_root() -> Path:
+    """The installed ``tony_tpu`` package directory."""
+    return Path(__file__).resolve().parents[1]
+
+
+def lint_tree(root: Path) -> List[LintViolation]:
+    """Lint every ``.py`` under ``root`` (a ``tony_tpu`` package dir)."""
+    root = Path(root)
+    out: List[LintViolation] = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        out.extend(lint_file(path, root))
+    return out
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    roots = [Path(a) for a in argv] or [default_root()]
+    violations: List[LintViolation] = []
+    for r in roots:
+        if not r.exists():
+            # A typo'd/misrooted path must fail the gate, not silently
+            # lint nothing and report clean.
+            print(f"srclint: path does not exist: {r}")
+            return 2
+        violations.extend(lint_file(r, r.parent) if r.is_file()
+                          else lint_tree(r))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"srclint: {len(violations)} violation(s)")
+        return 1
+    print("srclint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

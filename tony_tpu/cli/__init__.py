@@ -212,6 +212,17 @@ def cmd_logs(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_analyze(args: argparse.Namespace) -> int:
+    """Run the jaxpr invariant analyzer (and optionally the source lint)
+    over the shipped train-step configs — the static half of the tier-1
+    gate, runnable anywhere the CPU wheel is (no TPU needed). The import
+    is jax-free (the analysis facade is lazy), so ``analysis_cli.main``
+    still gets to set the virtual-CPU-mesh env BEFORE jax initializes."""
+    from tony_tpu.analysis import cli as analysis_cli
+
+    return analysis_cli.main(args)
+
+
 def cmd_version(_args: argparse.Namespace) -> int:
     print(f"tony-tpu {__version__}")
     return 0
@@ -295,6 +306,28 @@ def make_parser() -> argparse.ArgumentParser:
     lg.add_argument("--tail", type=int, default=0,
                     help="only the last N lines of each log (0 = all)")
     lg.set_defaults(fn=cmd_logs)
+
+    from tony_tpu.analysis.cli import CONFIG_NAMES  # jax-free constants
+
+    an = sub.add_parser("analyze", help="run the jaxpr sharding/"
+                        "collective invariant analyzer over the shipped "
+                        "train-step configs")
+    an.add_argument("--config", default="all",
+                    choices=("all",) + CONFIG_NAMES,
+                    help="which canonical config to analyze "
+                         "(default: all)")
+    an.add_argument("--json", help="also write the full structured "
+                    "reports to this path")
+    an.add_argument("--signatures", help="directory of committed step-"
+                    "signature pins to check against "
+                    "(e.g. tests/signatures)")
+    an.add_argument("--update-signatures", action="store_true",
+                    help="rewrite the signature pins instead of checking "
+                         "(commit the diff)")
+    an.add_argument("--lint", action="store_true",
+                    help="also run the jnp.concatenate/stack pack-site "
+                         "source lint (make lint)")
+    an.set_defaults(fn=cmd_analyze)
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=cmd_version)

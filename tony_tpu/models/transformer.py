@@ -105,6 +105,8 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     x1, x2 = x[..., ::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
     y2 = x1 * sin + x2 * cos
+    # packsite: region-local — elementwise RoPE recombination along a
+    # NEW trailing axis; operands share one sharding, no shard-dim concat.
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 

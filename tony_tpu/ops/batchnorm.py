@@ -58,6 +58,8 @@ def pick_block_rows(m: int, c: int, itemsize: int = 2,
 def _stats_kernel(x_ref, out_ref):
     i = pl.program_id(0)
     xf = x_ref[...].astype(jnp.float32)
+    # packsite: region-local — pallas kernel body; per-tile VMEM refs,
+    # no GSPMD shardings exist here.
     part = jnp.concatenate([
         jnp.sum(xf, axis=0, keepdims=True),
         jnp.sum(xf * xf, axis=0, keepdims=True)], axis=0)   # [2, C]
@@ -116,6 +118,7 @@ def _bwd_reduce_kernel(dy_ref, x_ref, stats_ref, gb_ref, out_ref, *,
     g = dy_ref[...].astype(jnp.float32)
     if relu:
         g = jnp.where(pre > 0, g, 0.0)
+    # packsite: region-local — pallas kernel body (per-tile VMEM refs).
     part = jnp.concatenate([
         jnp.sum(g, axis=0, keepdims=True),             # dβ
         jnp.sum(g * xhat, axis=0, keepdims=True)], axis=0)   # dγ
@@ -137,6 +140,7 @@ def _bwd_reduce_res_kernel(dy_ref, x_ref, res_ref, stats_ref, gb_ref,
     if relu:
         pre = pre + res_ref[...].astype(jnp.float32)
         g = jnp.where(pre > 0, g, 0.0)
+    # packsite: region-local — pallas kernel body (per-tile VMEM refs).
     part = jnp.concatenate([
         jnp.sum(g, axis=0, keepdims=True),
         jnp.sum(g * xhat, axis=0, keepdims=True)], axis=0)
@@ -203,8 +207,10 @@ def _bn_act_fwd_impl(x2d, gamma, beta, res2d, eps, relu, bm, interpret):
     sums = _bn_sums(x2d, bm, interpret)
     mean = sums[0] / m
     var = jnp.maximum(sums[1] / m - mean * mean, 0.0)
+    # packsite: region-local — [2, C] channel stats, replicated scalars
+    # per channel; no shard-dim concat.
     stats = jnp.stack([mean, var])              # [2, C] f32
-    gb = jnp.stack([gamma, beta]).astype(jnp.float32)
+    gb = jnp.stack([gamma, beta]).astype(jnp.float32)  # packsite: region-local
     if res2d is None:
         out = pl.pallas_call(
             functools.partial(_apply_kernel, eps=eps, relu=relu),

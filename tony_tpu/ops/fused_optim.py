@@ -462,6 +462,26 @@ class FusedOptimizer:
                 new_leaves[i] = v
         return new_leaves, new_slots, gnorm
 
+    def region_collectives(self, plan: GradBuckets, *,
+                           sharded: bool = True,
+                           axis: str = FSDP
+                           ) -> List[Tuple[str, Tuple[str, ...], int, str]]:
+        """The collectives :meth:`region_apply` itself issues, as
+        ``(kind, axes, nbytes, note)`` tuples — the fused plane's
+        contribution to the static analyzer's planned set (the scalar
+        grad-norm psums are below any audit threshold and deliberately
+        omitted): one param ``all_gather`` per PADDED scatter bucket
+        (uneven leaves exit the region whole, so their updated params
+        re-gather once)."""
+        out: List[Tuple[str, Tuple[str, ...], int, str]] = []
+        if not sharded:
+            return out
+        for b in range(plan.n_buckets):
+            if plan._is_scatter(b) and plan._is_padded(b):
+                out.append(("all_gather", (axis,), plan.bucket_nbytes[b],
+                            f"bucket {b} padded param re-gather"))
+        return out
+
     def record(self, tag: str, plan: GradBuckets, **extra) -> None:
         """Bank the update schedule into ``profiler.update_report()``."""
         _record(tag, rule=self.rule, impl=self.resolved_impl(),

@@ -496,6 +496,88 @@ class GradBuckets:
 _record = functools.partial(trace_record, "overlap")
 
 
+def reduce_schedule(plan: "GradBuckets", mesh: Mesh, *,
+                    reduce_op: str = "all_reduce",
+                    hierarchy: str = "auto"
+                    ) -> Tuple[List[Tuple[str, list]], Tuple[str, ...],
+                               int, bool]:
+    """THE per-bucket reduce schedule — one derivation shared by the accum
+    engine (which executes it) and the static analyzer (which audits the
+    traced program against it; if they ever derived it separately the
+    audit would drift from the code it checks).
+
+    Each bucket gets ``(mode, post_groups)``: mode fixes the in-scan
+    collective + accumulator shape; post_groups are the psum axis groups
+    issued after the scatter — hierarchical keeps the DCN hop its OWN
+    collective so the scheduler can slide it independently of the ICI
+    phase.
+
+    * ``"scatter"``: psum_scatter over fsdp into the ZeRO-3 shard layout
+    * ``"rs"``:      psum_scatter over the (padded) reduce group + tail AG
+    * ``"ar"``:      plain psum
+
+    Returns ``(sched, rs_axes, rs_group, hier)`` where ``rs_axes``/
+    ``rs_group`` are the psum_scatter group of the ``"rs"`` buckets and
+    ``hier`` says whether the DCN level exists.
+    """
+    if reduce_op not in ("all_reduce", "reduce_scatter"):
+        raise ValueError(f"unknown reduce op {reduce_op!r} "
+                         "(all_reduce|reduce_scatter)")
+    if hierarchy not in ("auto", "flat", "hierarchical"):
+        raise ValueError(f"unknown hierarchy {hierarchy!r} "
+                         "(auto|flat|hierarchical)")
+    axes = sync_axes(mesh)
+    ici = ici_axes(mesh)
+    dcn = dcn_axis(mesh)
+    if hierarchy == "hierarchical" and dcn is None:
+        raise ValueError(
+            "hierarchy='hierarchical' needs a multi-slice mesh (slice "
+            "axis > 1); build one with MeshSpec(slices=...)")
+    hier = dcn is not None and hierarchy != "flat"
+    ici_group = 1
+    for a in ici:
+        ici_group *= mesh.shape[a]
+    group = sync_size(mesh)
+    sched: List[Tuple[str, list]] = []
+    for b in range(plan.n_buckets):
+        if plan._is_scatter(b):
+            if hier:
+                post = [_present(mesh, tuple(a for a in ici if a != FSDP)),
+                        (dcn,)]
+            else:
+                post = [_present(mesh,
+                                 tuple(a for a in axes if a != FSDP))]
+            sched.append(("scatter", [g for g in post if g]))
+        elif hier:
+            sched.append(("rs", [(dcn,)]))
+        elif reduce_op == "reduce_scatter":
+            sched.append(("rs", []))
+        else:
+            sched.append(("ar", []))
+    rs_axes = ici if hier else axes
+    rs_group = ici_group if hier else group
+    return sched, rs_axes, rs_group, hier
+
+
+def step_plans(params: Any, mesh: Mesh, *,
+               bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+               param_specs: Optional[Any] = None,
+               prefetch: int = 1):
+    """``(plan, gather_plan)`` exactly as :func:`microbatch_grads` derives
+    them for a step over ``params`` — the one planning entry the engine,
+    the stepper's ``inspect`` hook, and the static analyzer all share.
+    ``gather_plan`` is ``None`` for replicated (non-ZeRO-3) layouts."""
+    from tony_tpu.parallel import sched as sched_mod  # lazy: no cycle
+
+    if param_specs is None:
+        return GradBuckets.plan(params, bucket_bytes), None
+    fsdp_size = mesh.shape[FSDP] if FSDP in mesh.axis_names else 1
+    plan = GradBuckets.plan_sharded(params, param_specs,
+                                    shard_size=fsdp_size,
+                                    bucket_bytes=bucket_bytes)
+    return plan, sched_mod.GatherPlan.from_buckets(plan, prefetch=prefetch)
+
+
 def region_param_specs(plan: "GradBuckets", param_specs: Any
                        ) -> Tuple[Any, List[Tuple[int, ...]]]:
     """Full-rank shard_map entry specs for a ZeRO-3 plan (shard_map wants
@@ -607,22 +689,10 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
 
     axes = sync_axes(mesh)
     group = sync_size(mesh)
-    ici = ici_axes(mesh)
     dcn = dcn_axis(mesh)
     if gather not in ("bucketed", "per_leaf"):
         raise ValueError(f"unknown gather mode {gather!r} "
                          "(bucketed|per_leaf)")
-    if hierarchy not in ("auto", "flat", "hierarchical"):
-        raise ValueError(f"unknown hierarchy {hierarchy!r} "
-                         "(auto|flat|hierarchical)")
-    if hierarchy == "hierarchical" and dcn is None:
-        raise ValueError(
-            "hierarchy='hierarchical' needs a multi-slice mesh (slice "
-            "axis > 1); build one with MeshSpec(slices=...)")
-    hier = dcn is not None and hierarchy != "flat"
-    ici_group = 1
-    for a in ici:
-        ici_group *= mesh.shape[a]
     lead = jax.tree.leaves(batch)[0].shape[0]
     if lead % (group * microbatches):
         raise ValueError(
@@ -632,16 +702,20 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     zero3 = param_specs is not None
     gplan = None
     if zero3:
-        fsdp_size = mesh.shape[FSDP] if FSDP in mesh.axis_names else 1
-        plan = buckets if buckets is not None else GradBuckets.plan_sharded(
-            params, param_specs, shard_size=fsdp_size,
-            bucket_bytes=bucket_bytes)
         # The forward-gather schedule is resolved HERE, once per plan —
         # which leaves gather, on which dim, in which bucket. The scan
         # body below just drives the static lists (the spec probing that
         # used to run per gather_params call is gone from the traced
         # path).
-        gplan = sched_mod.GatherPlan.from_buckets(plan, prefetch=prefetch)
+        if buckets is not None:
+            plan = buckets
+            gplan = sched_mod.GatherPlan.from_buckets(plan,
+                                                      prefetch=prefetch)
+        else:
+            plan, gplan = step_plans(params, mesh,
+                                     bucket_bytes=bucket_bytes,
+                                     param_specs=param_specs,
+                                     prefetch=prefetch)
         p_specs, uneven = region_param_specs(plan, param_specs)
         if uneven:
             # Loud on purpose: these leaves lose the ZeRO-3 per-leaf
@@ -659,35 +733,10 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     b_specs = jax.tree.map(lambda _: P(axes), batch)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
 
-    # Per-bucket reduce schedule, resolved at trace time. Each bucket gets
-    # (mode, post_groups): mode fixes the in-scan collective + accumulator
-    # shape; post_groups are the psum axis groups issued after the scatter
-    # — hierarchical keeps the DCN hop its OWN collective so the scheduler
-    # can slide it independently of the ICI phase.
-    #   "scatter": psum_scatter over fsdp into the ZeRO-3 shard layout
-    #   "rs":      psum_scatter over the (padded) reduce group + tail AG
-    #   "ar":      plain psum
-    if reduce_op not in ("all_reduce", "reduce_scatter"):
-        raise ValueError(f"unknown reduce op {reduce_op!r} "
-                         "(all_reduce|reduce_scatter)")
-    sched = []
-    for b in range(plan.n_buckets):
-        if plan._is_scatter(b):
-            if hier:
-                post = [_present(mesh, tuple(a for a in ici if a != FSDP)),
-                        (dcn,)]
-            else:
-                post = [_present(mesh,
-                                 tuple(a for a in axes if a != FSDP))]
-            sched.append(("scatter", [g for g in post if g]))
-        elif hier:
-            sched.append(("rs", [(dcn,)]))
-        elif reduce_op == "reduce_scatter":
-            sched.append(("rs", []))
-        else:
-            sched.append(("ar", []))
-    rs_axes = ici if hier else axes          # psum_scatter group for "rs"
-    rs_group = ici_group if hier else group
+    # Per-bucket reduce schedule, resolved at trace time — ONE derivation
+    # shared with the static analyzer (see :func:`reduce_schedule`).
+    sched, rs_axes, rs_group, hier = reduce_schedule(
+        plan, mesh, reduce_op=reduce_op, hierarchy=hierarchy)
 
     levels: List[Dict[str, object]] = []
     if zero3 and plan.n_scatter_buckets:

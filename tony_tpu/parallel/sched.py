@@ -123,6 +123,23 @@ class GatherPlan:
         collective materializes, shard_size × what each chip sends)."""
         return tuple(self.plan.bucket_nbytes[b] for b in self.gather_buckets)
 
+    def window_nbytes(self) -> int:
+        """The prefetch-window memory promise: the most replicated bytes
+        the chained gathers may have in flight at once — bucket *k* can't
+        issue before bucket *k − prefetch* exists, so at most
+        ``prefetch + 1`` consecutive gathered buffers coexist as fresh
+        gathers (``prefetch = 0`` disables the chain: everything may
+        issue eagerly). This is the bound the analyzer's replication-leak
+        rule audits the traced step against."""
+        sizes = self.gather_nbytes
+        if not sizes:
+            return 0
+        if not self.prefetch:
+            return sum(sizes)
+        width = min(len(sizes), self.prefetch + 1)
+        return max(sum(sizes[k:k + width])
+                   for k in range(len(sizes) - width + 1))
+
     def gather(self, leaves: Sequence[jax.Array]) -> List[jax.Array]:
         """Region-local leaves (shard layout) → full leaves, one
         ``all_gather`` per bucket, prefetch-chained. Must be called inside
@@ -133,6 +150,8 @@ class GatherPlan:
         for k, b in enumerate(self.gather_buckets):
             idxs = plan.buckets[b]
             parts = [leaves[i].reshape(-1) for i in idxs]
+            # packsite: region-local — inside the shard_map region these
+            # are per-device shard buffers, never GSPMD-sharded arrays.
             chunk = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
             if self.prefetch and k >= self.prefetch:
                 # Bucket k may not start gathering before bucket

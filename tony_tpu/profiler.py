@@ -197,6 +197,33 @@ def reset_update_records() -> None:
     UPDATE_RECORDS.clear()
 
 
+# ---------------------------------------------------------------------------
+# Static-analysis instrumentation (tony_tpu.analysis): the jaxpr analyzer
+# banks one record per analyzed step — finding counts by rule, waived
+# count, the step-signature digest (eqn/collective counts, live-buffer
+# high-water estimate) — keyed by analysis tag (the config name passed to
+# `tony analyze` / analyze_accum_step); last run per tag wins. This is the
+# machine-readable face of `analysis_report()` the ISSUE names alongside
+# the existing report family.
+ANALYSIS_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_analysis(tag: str, /, **fields) -> None:
+    """Bank one static-analysis record (findings by rule, waived count,
+    signature digest, collective census...)."""
+    ANALYSIS_RECORDS[tag] = dict(fields)
+
+
+def analysis_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded analysis run (deep-copied via
+    :func:`_snapshot` — same aliasing contract as the other reports)."""
+    return _snapshot(ANALYSIS_RECORDS)
+
+
+def reset_analysis_records() -> None:
+    ANALYSIS_RECORDS.clear()
+
+
 # One guarded entry point for the trace-side recorders (overlap grad sync,
 # ckpt snapshot, input prefetch): bookkeeping must never sink a step or a
 # save, and a broken wiring is logged once per registry at DEBUG — not per
@@ -206,11 +233,12 @@ _SAFE_RECORD_FAILED: set = set()
 
 def safe_record(kind: str, tag: str, /, **fields) -> None:
     """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
-    ``"input"``/``"collective"``/``"update"``), swallowing any failure."""
+    ``"input"``/``"collective"``/``"update"``/``"analysis"``), swallowing
+    any failure."""
     try:
         {"overlap": record_overlap, "ckpt": record_ckpt,
          "input": record_input, "collective": record_collective,
-         "update": record_update}[kind](
+         "update": record_update, "analysis": record_analysis}[kind](
              tag, **fields)
     except Exception:  # noqa: BLE001
         if kind not in _SAFE_RECORD_FAILED:

@@ -339,6 +339,15 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     # actually changes (in practice, once).
     jitted: Dict[Any, Any] = {}
 
+    def _jitted_for(state):
+        leaves, treedef = jax.tree.flatten(state.params)
+        key = (treedef,
+               tuple(getattr(l, "sharding", None) for l in leaves))
+        if key not in jitted:
+            jitted[key] = build(overlap.fsdp_param_specs(
+                state.params, mesh))
+        return jitted[key]
+
     def stepper(state, batch):
         if update == "fused_bucket":
             from tony_tpu.ops import fused_optim
@@ -355,14 +364,33 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                     f"disagrees with the FusedOptimizer's "
                     f"{state.tx.bucket_bytes} — the tx's value sized the "
                     f"bucket-resident opt state and wins; set it there")
-        leaves, treedef = jax.tree.flatten(state.params)
-        key = (treedef,
-               tuple(getattr(l, "sharding", None) for l in leaves))
-        if key not in jitted:
-            jitted[key] = build(overlap.fsdp_param_specs(
-                state.params, mesh))
         with mesh_context(mesh):
-            return jitted[key](state, batch)
+            return _jitted_for(state)(state, batch)
+
+    def inspect(state):
+        """Static-analysis hook: the jitted step this stepper would run
+        for ``state``'s layout, plus the planner artifacts and config
+        knobs it was built from — everything
+        :func:`tony_tpu.analysis.analyze_accum_step` needs to audit the
+        traced program against the plan it claims to execute. Plans come
+        from :func:`~tony_tpu.parallel.overlap.step_plans`, the SAME
+        derivation ``microbatch_grads`` uses, so the audit target can
+        never drift from the step."""
+        param_specs = overlap.fsdp_param_specs(state.params, mesh)
+        bb = state.tx.bucket_bytes if update == "fused_bucket" \
+            else bucket_bytes
+        plan, gplan = overlap.step_plans(
+            state.params, mesh, bucket_bytes=bb, param_specs=param_specs,
+            prefetch=prefetch)
+        return {"jitted": _jitted_for(state), "plan": plan,
+                "gplan": gplan, "mesh": mesh, "update": update,
+                "gather": gather, "reduce_op": reduce_op,
+                "hierarchy": hierarchy, "donate": donate,
+                "microbatches": microbatches, "bucket_bytes": bb,
+                "param_specs": param_specs,
+                "fused": state.tx if update == "fused_bucket" else None}
+
+    stepper.inspect = inspect
     return stepper
 
 
