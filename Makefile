@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-analysis tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-analysis
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -51,6 +51,16 @@ tier1-sched:
 # changed fsdp topologies.
 tier1-optim:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'optim and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Quantized-lane marker leg — int8 matmul kernel vs XLA fallback
+# bit-exactness, per-channel scales, delayed-scaling windows,
+# quantize-on-gather exactness + pad inertness, the LOSS-PIN gate, and
+# the scale-state ckpt round-trip. Runs the FULL quant selection (slow
+# included): the model loss pins and the cross-topology ckpt round-trip
+# are slow-marked to keep tier1-verify inside its timeout, but this
+# named leg is the lane's gate and must see all of them.
+tier1-quant:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m quant -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Static-analysis marker leg (also inside tier1-verify's selection) — the
 # jaxpr invariant analyzer: shipped configs clean, every rule fires on a

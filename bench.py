@@ -208,6 +208,36 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["optim_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_QUANT", "1") != "0":
+        # Quantized-lane leg (tony_tpu.ops.quant): int8 matmul vs bf16
+        # wall time (on CPU the MXU win can't show — the leg documents
+        # that and the metal run rides the hardware debt list), int8
+        # gather bytes vs the BENCH_r09 bucketed path (4x for f32
+        # params, bit-exact dequant pin), and the quantized-gather loss
+        # pin gating both claims.
+        try:
+            from tony_tpu.benchmark import run_quant_bench
+            qb = run_quant_bench(on_tpu=on_tpu)
+            result["quant_bf16_matmul_s"] = qb["bf16_matmul_s"]
+            result["quant_matmul_s"] = qb["quant_matmul_s"]
+            result["quant_matmul_speedup"] = qb["quant_matmul_speedup"]
+            result["quant_kernel_bitexact"] = qb["quant_kernel_bitexact"]
+            if "quant_matmul_sim_note" in qb:
+                result["quant_matmul_sim_note"] = qb["quant_matmul_sim_note"]
+            result["quant_gather_raw_nbytes"] = qb.get("gather_raw_nbytes")
+            result["quant_gather_int8_nbytes"] = qb.get(
+                "gather_int8_nbytes")
+            result["quant_gather_bytes_ratio"] = qb.get(
+                "gather_bytes_ratio")
+            result["quant_gather_2x_fewer_ok"] = qb.get(
+                "gather_2x_fewer_ok")
+            result["quant_gather_roundtrip_bitexact"] = qb.get(
+                "gather_roundtrip_bitexact")
+            result["quant_losspin_ok"] = qb.get("losspin_ok")
+            result["quant_losspin_rel"] = qb.get("losspin_rel")
+        except Exception as e:  # secondary metric must not sink the bench
+            result["quant_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

@@ -41,7 +41,8 @@ _TIER_BY_MODULE = {
     "test_runtimes": "quick", "test_security": "quick",
     "test_executor": "quick", "test_satellites": "quick",
     "test_checkpoint": "jit", "test_ckpt": "jit", "test_data": "jit",
-    "test_ops": "jit", "test_fused_optim": "jit", "test_models": "jit",
+    "test_ops": "jit", "test_fused_optim": "jit", "test_quant": "jit",
+    "test_models": "jit",
     "test_moe": "jit", "test_batchnorm": "jit", "test_parallel": "jit",
     "test_pipeline": "jit", "test_overlap": "jit", "test_multislice": "jit",
     "test_sched": "jit",

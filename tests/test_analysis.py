@@ -229,6 +229,46 @@ class TestDtypePolicy:
                 if f.kind == "f64_promotion"]
         assert hits
 
+    def test_int8_carried_reduction_fires(self):
+        """Rule 3 seeded violation (quantized lane): a psum carrying
+        int8 — narrow integer reductions saturate; int8 rides only
+        non-accumulating collectives like the quantized gather."""
+        mesh = par.make_mesh()
+
+        def spmd(x):
+            return jax.lax.psum(x, ("data",))
+
+        closed = jax.make_jaxpr(shard_map(
+            spmd, mesh, in_specs=(P(),), out_specs=P()))(
+                jnp.ones((8, 4), jnp.int8))
+        hits = [f for f in rules.dtype_findings(closed)
+                if f.kind == "int_carried_reduction"]
+        assert hits
+        assert "int8" in hits[0].message and "psum" in hits[0].message
+
+    def test_int8_narrow_accumulation_fires(self):
+        """Rule 3 seeded violation: an int8×int8 dot_general without
+        preferred_element_type=int32 accumulates in int8."""
+        closed = jax.make_jaxpr(lambda a, b: jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ()))))(
+                jnp.ones((4, 8), jnp.int8), jnp.ones((8, 4), jnp.int8))
+        hits = [f for f in rules.dtype_findings(closed)
+                if f.kind == "narrow_int_accumulation"]
+        assert hits
+        assert "int32" in hits[0].message
+
+    def test_quant_dot_int32_accumulation_blessed(self):
+        """The quantized lane's pattern — int8→int32 dot_general with
+        f32 rescale — passes rule 3 with ZERO findings (including the
+        quantize round/clip and the rescale casts)."""
+        from tony_tpu.ops import quant as quant_mod
+
+        closed = jax.make_jaxpr(
+            lambda x, w: quant_mod.quant_dot(x, w, impl="xla"))(
+                jnp.ones((8, 16), jnp.float32),
+                jnp.ones((16, 8), jnp.float32))
+        assert not rules.dtype_findings(closed)
+
     def test_bf16_moment_slot_fires(self):
         """Rule 3 seeded violation: one fused moment-slot bucket cast to
         bf16 — the finding names the exact slot and bucket."""

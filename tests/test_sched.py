@@ -271,6 +271,7 @@ class TestReportAliasing:
         ("collective", profiler.collective_report,
          profiler.reset_collective_records),
         ("update", profiler.update_report, profiler.reset_update_records),
+        ("quant", profiler.quant_report, profiler.reset_quant_records),
         ("analysis", profiler.analysis_report,
          profiler.reset_analysis_records),
     ])

@@ -170,7 +170,8 @@ def analyze_accum_step(stepper: Any, state: Any, batch: Any, *,
     expected = expected_accum_collectives(
         info["plan"], info["gplan"], info["mesh"], gather=info["gather"],
         reduce_op=info["reduce_op"], hierarchy=info["hierarchy"],
-        update=info["update"], fused=info.get("fused"))
+        update=info["update"], fused=info.get("fused"),
+        quant=bool(info.get("quant")))
     donate_argnums = tuple(getattr(traced, "donate_argnums", ()) or ())
     donated = _donated_flags((state, batch), donate_argnums)
     if len(donated) != len(closed.jaxpr.invars):
@@ -193,7 +194,7 @@ def analyze_accum_step(stepper: Any, state: Any, batch: Any, *,
     active, waived = apply_waivers(findings, waivers)
     config = {k: info[k] for k in ("update", "gather", "reduce_op",
                                    "hierarchy", "microbatches",
-                                   "bucket_bytes", "donate")
+                                   "bucket_bytes", "donate", "quant")
               if k in info}
     config["donate_argnums"] = list(donate_argnums)
     report = AnalysisReport(

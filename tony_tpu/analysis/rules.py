@@ -115,13 +115,16 @@ def expected_accum_collectives(plan: Any, gplan: Optional[Any], mesh: Any,
                                reduce_op: str = "all_reduce",
                                hierarchy: str = "auto",
                                update: str = "optax",
-                               fused: Optional[Any] = None
+                               fused: Optional[Any] = None,
+                               quant: bool = False
                                ) -> List[Expected]:
     """The full planned-collective multiset of one
     ``make_accum_train_step`` trace, derived from the SAME planner
     artifacts the engine executes (``reduce_schedule`` is shared code, so
     the audit can't drift from the step): forward gathers (bucketed or
-    per-leaf), the per-bucket reduce schedule with its post-scatter psum
+    per-leaf — int8-sized when the quantized lane is on: 1 B/element on
+    the wire, the scalar amax ``pmax``es ride under the auto-accept
+    threshold), the per-bucket reduce schedule with its post-scatter psum
     groups, the tail re-gathers, and — for the fused-optimizer path — the
     update plane's own param re-gathers."""
     from tony_tpu.parallel import overlap
@@ -131,8 +134,10 @@ def expected_accum_collectives(plan: Any, gplan: Optional[Any], mesh: Any,
     if zero3:
         if gather == "bucketed":
             for b in gplan.gather_buckets:
+                nb = plan.bucket_numel[b] if quant \
+                    else plan.bucket_nbytes[b]
                 _add(exp, "all_gather", (gplan.axis,),
-                     plan.bucket_nbytes[b], "fwd_gather", f"bucket {b}")
+                     nb, "fwd_gather", f"bucket {b}")
         else:
             for i, _d in gplan.gather_leaves:
                 nb = int(np.prod(plan.shapes[i], dtype=np.int64)) \
@@ -283,15 +288,47 @@ def check_prefetch_chain(closed: Any, gplan: Optional[Any],
 _REDUCTION_PRIMS = ("reduce_sum", "psum", "reduce_scatter", "add_any",
                     "cumsum")
 _LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+# Integer carries narrower than int32 SATURATE instead of losing
+# mantissa: an int8-carried psum wraps/clips at the second operand. The
+# quantized lane ships int8 only through non-accumulating collectives
+# (all_gather) and accumulates every dot in int32 — that pair is the
+# blessed pattern; everything else is a finding.
+_NARROW_INT = (jnp.int8, jnp.int16, jnp.uint8, jnp.uint16)
 
 
 def dtype_findings(closed: Any) -> List[Finding]:
     """f64 must never appear (a silent promotion doubles every byte count
-    the planner budgeted) and bf16/f16 must never be the carry dtype of a
-    reduction."""
+    the planner budgeted); bf16/f16 must never be the carry dtype of a
+    reduction and int8/int16 must never be one either (they saturate);
+    an int8×int8 ``dot_general`` must accumulate wide
+    (``preferred_element_type=int32`` — the quantized lane's blessed
+    int8→int32-with-f32-rescale pattern passes untouched)."""
     out: List[Finding] = []
     for path, i, eqn in jw.iter_eqns(closed):
         prov = ""
+        if eqn.primitive.name == "dot_general":
+            in_dts = [getattr(getattr(v, "aval", None), "dtype", None)
+                      for v in eqn.invars]
+            out_dt = getattr(getattr(eqn.outvars[0], "aval", None),
+                             "dtype", None)
+            if (len(in_dts) == 2 and out_dt is not None
+                    and all(dt is not None and any(dt == nd for nd in
+                                                   _NARROW_INT)
+                            for dt in in_dts)
+                    and any(out_dt == nd for nd in _NARROW_INT)):
+                out.append(Finding(
+                    rule="dtype_policy", kind="narrow_int_accumulation",
+                    severity="error",
+                    message=(f"dot_general over "
+                             f"{np.dtype(in_dts[0]).name} operands "
+                             f"accumulates in {np.dtype(out_dt).name} — "
+                             f"int8 matmuls must accumulate wide "
+                             f"(preferred_element_type=int32, the "
+                             f"quantized lane's blessed pattern)"),
+                    provenance=jw.CollectiveEqn(
+                        eqn.primitive.name, (), jw.eqn_out_nbytes(eqn),
+                        path, i, jw.source_of(eqn)).provenance,
+                    nbytes=jw.eqn_out_nbytes(eqn)))
         for v in eqn.outvars:
             dt = getattr(getattr(v, "aval", None), "dtype", None)
             if dt is None:
@@ -311,14 +348,23 @@ def dtype_findings(closed: Any) -> List[Finding]:
         if eqn.primitive.name in _REDUCTION_PRIMS:
             for v in eqn.outvars:
                 dt = getattr(getattr(v, "aval", None), "dtype", None)
-                if dt is not None and any(dt == lp
-                                          for lp in _LOW_PRECISION):
+                if dt is None:
+                    continue
+                low = any(dt == lp for lp in _LOW_PRECISION)
+                narrow = any(dt == nd for nd in _NARROW_INT)
+                if low or narrow:
+                    why = "reductions must carry f32 (bf16 never " \
+                          "accumulates)" if low else \
+                          "narrow integer reductions saturate — carry " \
+                          "int32/f32 (int8 rides only non-accumulating " \
+                          "collectives like the quantized gather)"
                     out.append(Finding(
-                        rule="dtype_policy", kind="low_precision_reduction",
+                        rule="dtype_policy",
+                        kind="low_precision_reduction" if low
+                        else "int_carried_reduction",
                         severity="error",
                         message=(f"{eqn.primitive.name} accumulates in "
-                                 f"{np.dtype(dt).name} — reductions must "
-                                 f"carry f32 (bf16 never accumulates)"),
+                                 f"{np.dtype(dt).name} — {why}"),
                         provenance=jw.CollectiveEqn(
                             eqn.primitive.name, jw.eqn_axes(eqn),
                             jw.eqn_out_nbytes(eqn), path, i,

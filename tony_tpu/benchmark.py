@@ -877,3 +877,138 @@ def run_resnet_bench(batch: int, image: int, steps: int, *,
         "s2d_stem": s2d,
         "loss": float(loss),
     }
+
+
+def run_quant_bench(*, m: int = 512, k: int = 1024, n: int = 1024,
+                    steps: int | None = None,
+                    on_tpu: bool | None = None) -> dict:
+    """Quantized-lane leg (tony_tpu.ops.quant): three gated numbers.
+
+    1. **Matmul wall time** — the int8×int8→int32+f32-rescale path vs the
+       bf16 matmul at a projection-sized shape, both jitted and fenced
+       best-of-N. On TPU metal the int8 MXU runs 2× bf16 peak
+       (ROOFLINE.md §7); on the CPU simulation XLA has no int8 fast path,
+       so the CPU number documents the dispatch overhead, not the win —
+       ``quant_matmul_sim_note`` says so explicitly and the metal
+       measurement rides the real-hardware debt list.
+    2. **Quantize-on-gather bytes** — raw vs int8 wire bytes of the
+       ZeRO-3 forward gathers from the live GatherPlan (the ≥2×-fewer-
+       gather-bytes claim vs BENCH_r09's bucketed path; 4× for f32
+       params), plus the bit-exactness pin (dequantized int8 gather ==
+       quantize∘dequantize of the unquantized gather).
+    3. **Loss pin** — a short quantized-gather accum training vs the
+       unquantized one; the relative final-loss disagreement gates the
+       byte claim the way ``numerics_ok`` gates every other leg.
+    """
+    import numpy as np
+    import optax
+
+    from tony_tpu import parallel as par
+    from tony_tpu import profiler
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+    from tony_tpu.ops import quant as q
+    from tony_tpu.parallel import overlap
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    if steps is None:
+        steps = 20 if on_tpu else 8
+    windows = int(os.environ.get("BENCH_WINDOWS", "3"))
+    ks = jax.random.split(jax.random.PRNGKey(0), 2)
+    x16 = jax.random.normal(ks[0], (m, k), jnp.bfloat16)
+    w16 = jax.random.normal(ks[1], (k, n), jnp.bfloat16) * 0.2
+
+    bf16_jit = jax.jit(lambda a, b: a @ b)
+    quant_jit = jax.jit(functools.partial(q.quant_dot, impl=None))
+
+    def timed(fn, *args):
+        fn(*args).block_until_ready()          # compile
+        fn(*args).block_until_ready()          # steady state
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(*args)
+            out.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best / steps
+
+    bf16_s = timed(bf16_jit, x16, w16)
+    quant_s = timed(quant_jit, x16, w16)
+    # Kernel-vs-fallback pin at a small shape (interpret mode compiles
+    # the whole padded grid on CPU — keep it cheap).
+    xs = jax.random.normal(ks[0], (33, 70), jnp.float32)
+    ws = jax.random.normal(ks[1], (70, 130), jnp.float32)
+    kernel_bitexact = bool(np.array_equal(
+        np.asarray(q.quant_dot(xs, ws, impl="xla")),
+        np.asarray(q.quant_dot(xs, ws, interpret=True))))
+
+    # --- quantize-on-gather: bytes + exactness + loss pin -------------
+    n_dev = len(jax.devices())
+    fsdp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    out: dict = {
+        "metric": "quant_bench",
+        "matmul_m_k_n": [m, k, n],
+        "bf16_matmul_s": round(bf16_s, 6),
+        "quant_matmul_s": round(quant_s, 6),
+        "quant_matmul_speedup": round(bf16_s / quant_s, 4)
+        if quant_s else None,
+        "quant_kernel_bitexact": kernel_bitexact,
+        "backend": jax.default_backend(),
+    }
+    if not on_tpu:
+        out["quant_matmul_sim_note"] = (
+            "CPU simulation: XLA has no int8 matmul fast path, so the "
+            "wall-time ratio here measures quantize/rescale overhead, "
+            "not the MXU win — int8 doubles MXU peak on metal "
+            "(ROOFLINE.md §7); measurement rides the real-hardware "
+            "debt list (ROADMAP)")
+    if fsdp < 2:
+        return out
+
+    mesh = par.make_mesh(fsdp=fsdp)
+    model = get_model("mnist-mlp", hidden=64)
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    data = {"x": jax.random.normal(kx, (64, 784), jnp.float32),
+            "y": jax.random.randint(ky, (64,), 0, 10)}
+    bb = 1 << 15
+
+    def fresh():
+        return fsdp_shard_state(tr.create_train_state(
+            model, optax.adamw(1e-3), data["x"], jax.random.PRNGKey(2)),
+            mesh)
+
+    profiler.reset_quant_records()
+    sp = fresh()
+    sq = q.with_gather_quant(fresh(), mesh, window=4, bucket_bytes=bb)
+    specs = overlap.fsdp_param_specs(sq.params, mesh)
+    plan, gplan = overlap.step_plans(sq.params, mesh, bucket_bytes=bb,
+                                     param_specs=specs)
+    raw = sum(gplan.gather_nbytes)
+    int8 = sum(plan.bucket_numel[b] for b in gplan.gather_buckets)
+    step_p = tr.make_accum_train_step(mesh=mesh, microbatches=4,
+                                      bucket_bytes=bb, donate=False)
+    step_q = tr.make_accum_train_step(mesh=mesh, microbatches=4,
+                                      bucket_bytes=bb, quant=True,
+                                      donate=False)
+    for _ in range(steps):
+        sp, mp = step_p(sp, data)
+        sq, mq = step_q(sq, data)
+    lp, lq = float(mp["loss"]), float(mq["loss"])
+    out.update({
+        "gather_raw_nbytes": raw,
+        "gather_int8_nbytes": int8,
+        "gather_bytes_ratio": round(raw / int8, 2) if int8 else None,
+        "gather_2x_fewer_ok": bool(int8 and raw / int8 >= 2.0),
+        "gather_roundtrip_bitexact": q.gather_roundtrip_exact(
+            sq.params, mesh, bb),
+        "losspin_steps": steps,
+        "losspin_plain": round(lp, 6),
+        "losspin_quant": round(lq, 6),
+        "losspin_rel": round(abs(lq - lp) / lp, 6) if lp else None,
+        "losspin_ok": bool(lp and abs(lq - lp) / lp < 0.02),
+        "fsdp": fsdp,
+        "quant_records": profiler.quant_report(),
+    })
+    return out

@@ -54,13 +54,21 @@ PORTABLE_CODECS: List[Tuple[str, Callable[[Any], bool],
 
 def register_portable_codec(name: str, predicate: Callable[[Any], bool],
                             encode: Callable[[Any], Any],
-                            decode: Callable[[Any, Any], Any]) -> None:
+                            decode: Callable[[Any, Any], Any],
+                            prepend: bool = False) -> None:
     """Register ``(predicate, encode, decode)`` under ``name`` (replacing
     an earlier registration of the same name — planes re-import under
     pytest). ``encode(tree) -> portable tree``; ``decode(tree, mesh) ->
-    live tree`` re-bound to the CURRENT topology."""
+    live tree`` re-bound to the CURRENT topology. First matching codec
+    wins; ``prepend`` registers ahead of the existing entries — for a
+    codec whose predicate SUBSUMES an earlier one's (the quant-gather
+    codec composes the fused-optimizer codec and must match first)."""
     PORTABLE_CODECS[:] = [c for c in PORTABLE_CODECS if c[0] != name]
-    PORTABLE_CODECS.append((name, predicate, encode, decode))
+    entry = (name, predicate, encode, decode)
+    if prepend:
+        PORTABLE_CODECS.insert(0, entry)
+    else:
+        PORTABLE_CODECS.append(entry)
 
 
 def encode_portable(tree: Any) -> Any:

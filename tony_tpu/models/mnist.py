@@ -13,13 +13,29 @@ from tony_tpu.models import register
 class MLP(nn.Module):
     hidden: int = 512
     classes: int = 10
+    # Quantized compute lane (tony_tpu.ops.quant): every Dense runs the
+    # int8×int8→int32 matmul with f32 rescale instead of the f32 matmul
+    # (same kernel+bias shapes per layer). This is the loss-pin gate's
+    # small harness: tests/test_quant.py trains both lanes and holds the
+    # curves together within the committed tolerance.
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
+        if self.quant:
+            from tony_tpu.ops.quant import QuantDense
+
+            # Explicit nn.Dense-style names: the two lanes share ONE
+            # param tree (Dense_i/kernel+bias), so a checkpoint trained
+            # on either lane restores into the other.
+            dense = lambda n, i: QuantDense(n, use_bias=True,
+                                            name=f"Dense_{i}")
+        else:
+            dense = lambda n, i: nn.Dense(n, name=f"Dense_{i}")
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.hidden)(x))
-        x = nn.relu(nn.Dense(self.hidden)(x))
-        return nn.Dense(self.classes)(x)
+        x = nn.relu(dense(self.hidden, 0)(x))
+        x = nn.relu(dense(self.hidden, 1)(x))
+        return dense(self.classes, 2)(x)
 
 
 class CNN(nn.Module):

@@ -21,7 +21,7 @@ TPU-first design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -64,10 +64,36 @@ class TransformerConfig:
     # per-chunk logits are consumed immediately and rematerialized in the
     # backward. __call__ then takes targets and returns the scalar loss.
     xent_chunk: int = 0
+    # Quantized compute lane (tony_tpu.ops.quant): which projection
+    # groups run int8×int8→int32 matmuls with f32 rescale. True =
+    # ("qkv", "o", "mlp"); a tuple selects explicitly ("lm_head" opts
+    # the unembed in). Embedding and norms stay bf16/f32 by policy. The
+    # lane is loss-pin gated: tests/test_quant.py holds the quantized
+    # tiny-transformer curve against bf16 within a committed tolerance.
+    quant: Any = None
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    def quant_lanes(self) -> frozenset:
+        """The validated set of quantized projection groups."""
+        if not self.quant:
+            return frozenset()
+        lanes = ("qkv", "o", "mlp") if self.quant is True else (
+            (self.quant,) if isinstance(self.quant, str)
+            else tuple(self.quant))
+        unknown = set(lanes) - {"qkv", "o", "mlp", "lm_head"}
+        if unknown:
+            raise ValueError(
+                f"unknown quant lane(s) {sorted(unknown)} — choose from "
+                f"('qkv', 'o', 'mlp', 'lm_head')")
+        if "lm_head" in lanes and self.xent_chunk:
+            raise ValueError(
+                "quant lane 'lm_head' is not supported with xent_chunk "
+                "(the fused head+loss consumes the kernel row-chunked; "
+                "quantize it separately or drop the lane)")
+        return frozenset(lanes)
 
     def flops_per_token(self) -> int:
         """≈6·N_matmul FLOPs per trained token (fwd+bwd), plus attention's
@@ -110,6 +136,23 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _proj_dense(cfg: TransformerConfig, lane: str, feats: int,
+                logical: Tuple[str, ...], name: str):
+    """One projection on either compute lane: ``nn.Dense`` (bf16 MXU) or
+    its quantized twin (int8 MXU, f32 rescale) when ``lane`` is in the
+    config's quant set — identical param tree paths either way, so a
+    checkpoint moves freely between the lanes."""
+    init = nn.with_logical_partitioning(
+        nn.initializers.lecun_normal(), logical)
+    if lane in cfg.quant_lanes():
+        from tony_tpu.ops.quant import QuantDense
+        return QuantDense(feats, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, name=name,
+                          kernel_init=init)
+    return nn.Dense(feats, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name=name, kernel_init=init)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
 
@@ -135,14 +178,11 @@ class Attention(nn.Module):
         # sharded ('fsdp', 'model') — the megatron TP layout. (DenseGeneral's
         # multi-dim features initialize flat then reshape, which breaks
         # logical-metadata unboxing under an active mesh.)
-        dense = lambda feats, logical, name: nn.Dense(
-            feats, use_bias=False, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name=name,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), logical))
-        q = dense(nh * hd, ("embed", "heads"), "wq")(x)
-        k = dense(nkv * hd, ("embed", "kv_heads"), "wk")(x)
-        v = dense(nkv * hd, ("embed", "kv_heads"), "wv")(x)
+        dense = lambda feats, logical, name, lane: _proj_dense(
+            cfg, lane, feats, logical, name)
+        q = dense(nh * hd, ("embed", "heads"), "wq", "qkv")(x)
+        k = dense(nkv * hd, ("embed", "kv_heads"), "wk", "qkv")(x)
+        v = dense(nkv * hd, ("embed", "kv_heads"), "wv", "qkv")(x)
         if (cfg.attention == "flash" and cfg.mesh is None
                 and hd % 128 == 0):
             # Packed layout: the kernel reads heads as lane offsets from
@@ -161,7 +201,7 @@ class Attention(nn.Module):
             out = flash_attention_packed(
                 q4.reshape(b, t, nh * hd), k4.reshape(b, t, nkv * hd), v,
                 nh, causal=True)
-            return dense(cfg.dim, ("heads", "embed"), "wo")(out)
+            return dense(cfg.dim, ("heads", "embed"), "wo", "o")(out)
         # [B, T, H·D] → [B, H, T, D]
         q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
@@ -193,7 +233,7 @@ class Attention(nn.Module):
         else:
             out = reference_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd)
-        return dense(cfg.dim, ("heads", "embed"), "wo")(out)
+        return dense(cfg.dim, ("heads", "embed"), "wo", "o")(out)
 
 
 class MLP(nn.Module):
@@ -202,11 +242,8 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda feats, logical, name: nn.DenseGeneral(
-            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name=name,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), logical))
+        dense = lambda feats, logical, name: _proj_dense(
+            cfg, "mlp", feats, logical, name)
         gate = dense(cfg.ffn_hidden, ("embed", "ffn"), "w_gate")(x)
         up = dense(cfg.ffn_hidden, ("embed", "ffn"), "w_up")(x)
         y = nn.silu(gate) * up
@@ -334,13 +371,11 @@ class Transformer(nn.Module):
                                                cfg.xent_chunk, cfg.dtype)
             return (x @ w.astype(cfg.dtype)).astype(jnp.float32)
         # lm_head matmul in bf16 (an f32 matmul runs at a fraction of MXU
-        # bf16 peak and this is ~2·dim·vocab FLOPs/token); logits cast to
-        # f32 afterwards for a stable softmax in the loss.
-        logits = nn.DenseGeneral(
-            cfg.vocab, axis=-1, use_bias=False, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name="lm_head",
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("embed", "vocab")))(x)
+        # bf16 peak and this is ~2·dim·vocab FLOPs/token) — or int8 when
+        # the "lm_head" quant lane is on; logits cast to f32 afterwards
+        # for a stable softmax in the loss.
+        logits = _proj_dense(cfg, "lm_head", cfg.vocab,
+                             ("embed", "vocab"), "lm_head")(x)
         return logits.astype(jnp.float32)
 
 

@@ -619,7 +619,8 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                      prefetch: int = 1,
                      fused: Optional[Any] = None,
                      opt_slots: Optional[Any] = None,
-                     opt_scal: Optional[jax.Array] = None):
+                     opt_scal: Optional[jax.Array] = None,
+                     quant_amax: Optional[Sequence[jax.Array]] = None):
     """Gradient accumulation over ``microbatches`` with per-bucket sync.
 
     ``loss_fn(params, microbatch) -> loss`` (or ``(loss, aux)`` with
@@ -684,6 +685,17 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     layout throughout. The return changes to ``(loss[, aux], new_params,
     new_slots, grad_norm)`` where the norm is the bucket-major global
     grad norm (post-reduce, pre-clip).
+
+    **Quantized gathers** (``quant_amax`` = per-gather-bucket f32
+    ``[window]`` amax histories, replicated — see
+    :mod:`tony_tpu.ops.quant`): the bucketed forward gathers ship int8.
+    Scales are DELAYED — derived from the history the state carries, so
+    every shard quantizes with the identical scale and the int8 wire
+    format is bit-exact against quantize-after-gather. The region
+    measures the current bucket amax once at entry (local max + ``pmax``
+    over fsdp — the params don't change inside the scan) and rolls it
+    into the history; the updated histories append to the return
+    (``..., new_amax``). ZeRO-3 + ``gather="bucketed"`` only.
     """
     from tony_tpu.parallel import sched as sched_mod  # lazy: no cycle
 
@@ -730,6 +742,17 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         plan = buckets if buckets is not None else GradBuckets.plan(
             params, bucket_bytes)
         p_specs = jax.tree.map(lambda _: P(), params)
+    quant = quant_amax is not None
+    if quant:
+        if not zero3 or gather != "bucketed":
+            raise ValueError(
+                "quantize-on-gather (quant_amax=) needs the ZeRO-3 "
+                "bucketed gather path (fsdp-sharded params, "
+                "gather='bucketed') — the int8 lane lives on the "
+                "GatherPlan bucket boundary")
+        from tony_tpu.ops import quant as _quant_mod
+
+        _quant_mod.check_quant_amax(gplan, quant_amax)
     b_specs = jax.tree.map(lambda _: P(axes), batch)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
 
@@ -786,7 +809,10 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
     sched_mod.record_reduce_levels("accum", levels)
     if zero3 and gplan.gather_leaves:
         if gather == "bucketed":
-            nbytes = list(gplan.gather_nbytes)
+            # The quantized lane ships int8 on the wire: 1 B/element
+            # instead of the bucket dtype's itemsize.
+            nbytes = [plan.bucket_numel[b] for b in gplan.gather_buckets] \
+                if quant else list(gplan.gather_nbytes)
         else:
             nbytes = [
                 int(np.prod(plan.shapes[i], dtype=np.int64))
@@ -794,15 +820,26 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         sched_mod.record_collective(
             "accum.fwd_gather", kind="all_gather", plane="fwd_gather",
             axes=[FSDP], nbytes=nbytes, gather=gather,
+            quant="int8" if quant else None,
             prefetch=gplan.prefetch if gather == "bucketed" else None,
             per_microbatch=microbatches)
+    if quant:
+        raw = list(gplan.gather_nbytes)
+        q_nb = [plan.bucket_numel[b] for b in gplan.gather_buckets]
+        trace_record(
+            "quant", "accum_gather", n_buckets=gplan.n_gather_buckets,
+            window=int(quant_amax[0].shape[0]) if quant_amax else 0,
+            raw_nbytes=raw, int8_nbytes=q_nb,
+            bytes_saved=sum(raw) - sum(q_nb),
+            per_microbatch=microbatches)
 
-    def gather_params(p):
+    def gather_params(p, scales=None):
         if not zero3:
             return p
         leaves = list(jax.tree.leaves(p))
         if gather == "bucketed":
-            return jax.tree.unflatten(plan.treedef, gplan.gather(leaves))
+            return jax.tree.unflatten(plan.treedef,
+                                      gplan.gather(leaves, scales=scales))
         # Per-leaf pin path: replicated/scalar/uneven leaves entered the
         # region whole and are not in the (static) drive list.
         for i, d in gplan.gather_leaves:
@@ -810,7 +847,24 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
                                            tiled=True)
         return jax.tree.unflatten(plan.treedef, leaves)
 
-    def spmd(params, local, slots=None, scal=None):
+    def spmd(params, local, slots=None, scal=None, qamax=None):
+        scales = None
+        new_amax: List[jax.Array] = []
+        if quant:
+            from tony_tpu.ops import quant as quant_mod
+
+            # Delayed scaling: THIS step quantizes with the scale the
+            # state carried in (identical on every shard — the int8
+            # gather's exactness rests on that); the CURRENT amax is
+            # measured once at region entry (params are loop-invariant
+            # inside the scan) and rolled into the history for the next
+            # step, the same in-region cadence as PR 7's opt slots.
+            leaves0 = jax.tree.leaves(params)
+            scales = [quant_mod.hist_scale(h) for h in qamax]
+            for k, b in enumerate(gplan.gather_buckets):
+                m = jax.lax.pmax(quant_mod.bucket_amax(
+                    [leaves0[i] for i in plan.buckets[b]]), gplan.axis)
+                new_amax.append(quant_mod.push_amax(qamax[k], m))
         mbs = jax.tree.map(
             lambda x: x.reshape((microbatches, x.shape[0] // microbatches)
                                 + x.shape[1:]), local)
@@ -826,7 +880,7 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
 
         def body(carry, mb):
             loss_acc, aux_acc, acc = carry
-            out, grads = grad_fn(gather_params(params), mb)
+            out, grads = grad_fn(gather_params(params, scales), mb)
             loss, aux = out if has_aux else (out, jnp.float32(0.0))
             bufs = plan.pack(grads)
             nxt = []
@@ -867,7 +921,7 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
             aux = jax.lax.psum(aux, axes) / denom
             return (loss, aux,
                     jax.tree.unflatten(plan.treedef, new_leaves),
-                    new_slots, gnorm)
+                    new_slots, gnorm) + ((new_amax,) if quant else ())
         # Tail: "rs" buckets re-gather ONCE over their scatter group;
         # even scatter buckets stay in the shard layout (that IS the
         # output); PADDED scatter buckets re-gather over fsdp and unpad —
@@ -891,8 +945,9 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         grads = jax.tree.map(lambda b: b / denom, tree)
         loss = jax.lax.psum(loss, axes) / denom
         aux = jax.lax.psum(aux, axes) / denom
-        return loss, aux, grads
+        return (loss, aux, grads) + ((new_amax,) if quant else ())
 
+    amax_specs = [P()] * len(quant_amax) if quant else None
     if fused is not None:
         if opt_slots is None or opt_scal is None:
             raise ValueError(
@@ -903,14 +958,29 @@ def microbatch_grads(loss_fn: Callable[[Any, Any], Any], params: Any,
         bspecs_f = fused.bucket_specs(plan)
         slot_specs = {n: list(bspecs_f) for n in fused.slot_names}
         fused.record("accum_update", plan, microbatches=microbatches)
-        loss, aux, new_params, new_slots, gnorm = compat.shard_map(
-            spmd, mesh,
-            in_specs=(p_specs, b_specs, slot_specs, P()),
-            out_specs=(P(), P(), p_specs, slot_specs, P()))(
-                params, batch, opt_slots, opt_scal)
+        in_specs = (p_specs, b_specs, slot_specs, P())
+        out_specs = (P(), P(), p_specs, slot_specs, P())
+        args = (params, batch, opt_slots, opt_scal)
+        if quant:
+            in_specs += (amax_specs,)
+            out_specs += (amax_specs,)
+            args += (list(quant_amax),)
+        outs = compat.shard_map(spmd, mesh, in_specs=in_specs,
+                                out_specs=out_specs)(*args)
+        loss, aux, new_params, new_slots, gnorm = outs[:5]
+        tail = (outs[5],) if quant else ()
         if has_aux:
-            return loss, aux, new_params, new_slots, gnorm
-        return loss, new_params, new_slots, gnorm
+            return (loss, aux, new_params, new_slots, gnorm) + tail
+        return (loss, new_params, new_slots, gnorm) + tail
+    if quant:
+        loss, aux, grads, new_hist = compat.shard_map(
+            lambda p, l, qa: spmd(p, l, qamax=qa), mesh,
+            in_specs=(p_specs, b_specs, amax_specs),
+            out_specs=(P(), P(), p_specs, amax_specs))(
+                params, batch, list(quant_amax))
+        if has_aux:
+            return loss, aux, grads, new_hist
+        return loss, grads, new_hist
     loss, aux, grads = compat.shard_map(
         spmd, mesh, in_specs=(p_specs, b_specs),
         out_specs=(P(), P(), p_specs))(params, batch)

@@ -41,7 +41,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Any, List, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -140,10 +140,20 @@ class GatherPlan:
         return max(sum(sizes[k:k + width])
                    for k in range(len(sizes) - width + 1))
 
-    def gather(self, leaves: Sequence[jax.Array]) -> List[jax.Array]:
+    def gather(self, leaves: Sequence[jax.Array],
+               scales: Optional[Sequence[jax.Array]] = None
+               ) -> List[jax.Array]:
         """Region-local leaves (shard layout) → full leaves, one
         ``all_gather`` per bucket, prefetch-chained. Must be called inside
-        a manually-sharded region over ``self.axis``."""
+        a manually-sharded region over ``self.axis``.
+
+        ``scales`` (one f32 scalar per gather bucket, IDENTICAL on every
+        shard — the quantized lane's delayed scales) switches the wire
+        format to int8: each chunk is symmetric-quantized before the
+        collective and dequantized on arrival, so the gather ships
+        ``itemsize×`` fewer bytes (4× for f32 params). Because the scale
+        is shared, quantize∘gather ≡ gather∘quantize bit-exact — see
+        :mod:`tony_tpu.ops.quant`."""
         plan = self.plan
         out = list(leaves)
         done: List[jax.Array] = []
@@ -159,7 +169,14 @@ class GatherPlan:
                 # bytes without serializing gather k behind its consumer.
                 dep = done[k - self.prefetch].reshape(-1)[0]
                 chunk, _ = jax.lax.optimization_barrier((chunk, dep))
-            full = jax.lax.all_gather(chunk, self.axis, tiled=True)
+            if scales is not None:
+                from tony_tpu.ops.quant import dequantize, quantize
+
+                q = jax.lax.all_gather(quantize(chunk, scales[k]),
+                                       self.axis, tiled=True)
+                full = dequantize(q, scales[k], chunk.dtype)
+            else:
+                full = jax.lax.all_gather(chunk, self.axis, tiled=True)
             done.append(full)
             # The gathered buffer is shard-major — exactly pack()'s scatter
             # layout — so the uneven-leaf exit path's "gathered" unpacking

@@ -198,6 +198,34 @@ def reset_update_records() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Quantized-lane instrumentation (tony_tpu.ops.quant): the int8 lane
+# records, at trace time, where quantization actually happened — per
+# quant_dot call site (shapes, impl, per-channel, int8 vs bf16 operand
+# bytes), the quantize-on-gather schedule (bucket count, delayed-scaling
+# window, raw vs int8 wire bytes = the 4×-fewer-gather-bytes claim as an
+# inspectable number), and the attach-time state geometry. Keyed by tag
+# ("dense.<name>", "accum_gather", "attach"); last plan per tag wins.
+# run_quant_bench serializes this next to the other records (BENCH_r11).
+QUANT_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_quant(tag: str, /, **fields) -> None:
+    """Bank one quantized-lane record (matmul shapes/impl, scale-window
+    geometry, gather bytes saved...)."""
+    QUANT_RECORDS[tag] = dict(fields)
+
+
+def quant_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded quantization site (deep-copied via
+    :func:`_snapshot` — same aliasing contract as the other reports)."""
+    return _snapshot(QUANT_RECORDS)
+
+
+def reset_quant_records() -> None:
+    QUANT_RECORDS.clear()
+
+
+# ---------------------------------------------------------------------------
 # Static-analysis instrumentation (tony_tpu.analysis): the jaxpr analyzer
 # banks one record per analyzed step — finding counts by rule, waived
 # count, the step-signature digest (eqn/collective counts, live-buffer
@@ -233,12 +261,13 @@ _SAFE_RECORD_FAILED: set = set()
 
 def safe_record(kind: str, tag: str, /, **fields) -> None:
     """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
-    ``"input"``/``"collective"``/``"update"``/``"analysis"``), swallowing
-    any failure."""
+    ``"input"``/``"collective"``/``"update"``/``"quant"``/
+    ``"analysis"``), swallowing any failure."""
     try:
         {"overlap": record_overlap, "ckpt": record_ckpt,
          "input": record_input, "collective": record_collective,
-         "update": record_update, "analysis": record_analysis}[kind](
+         "update": record_update, "quant": record_quant,
+         "analysis": record_analysis}[kind](
              tag, **fields)
     except Exception:  # noqa: BLE001
         if kind not in _SAFE_RECORD_FAILED:

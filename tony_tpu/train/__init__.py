@@ -223,6 +223,7 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                           gather: str = "bucketed",
                           prefetch: int = 1,
                           update: str = "optax",
+                          quant: bool = False,
                           donate: bool = True,
                           apply_kwargs_of: Optional[Callable[
                               [Dict[str, jax.Array]],
@@ -270,6 +271,16 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     fused reduction (per-leaf value up to fp reassociation). The bucket
     plan is the tx's (``bucket_bytes`` on the FusedOptimizer — the
     ``bucket_bytes`` argument here must agree, it sized the opt state).
+
+    ``quant=True`` switches the ZeRO-3 forward param gathers to the
+    quantized int8 wire format (:mod:`tony_tpu.ops.quant`): the state
+    must be a :class:`~tony_tpu.ops.quant.QuantTrainState` (attach with
+    ``quant.with_gather_quant``) whose delayed-scaling amax histories
+    ride the step — f32 master params and the scatter-bucket gradient
+    reduce are untouched; only the forward gather bytes shrink (4× for
+    f32 params). Requires ``gather="bucketed"``; composes with both
+    ``update`` modes. The loss-pin gate in ``tests/test_quant.py`` is
+    the numerics contract for this knob.
     """
     if mesh is None:
         raise ValueError("make_accum_train_step requires a mesh: the "
@@ -277,6 +288,10 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     if update not in ("optax", "fused_bucket"):
         raise ValueError(f"unknown update mode {update!r} "
                          "(optax|fused_bucket)")
+    if quant and gather != "bucketed":
+        raise ValueError(
+            "quant=True quantizes the BUCKETED gather wire format; "
+            f"gather={gather!r} has no bucket boundary to quantize at")
     if loss_of is None:
         loss_of = lambda logits, batch: cross_entropy_loss(logits, batch["y"])
 
@@ -294,39 +309,47 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                           start=jnp.float32(0.0))
                 return loss_of(logits, mb) + aux, aux
 
+            qamax = state.quant_state["amax"] if quant else None
             if update == "fused_bucket":
                 # Bucket-major end to end: the optimizer update runs in
                 # the accum region on the scan's reduce accumulators —
                 # one fused kernel per bucket, grad norm included.
                 count_inc = state.opt_state["count"] + 1
                 scal = state.tx.scalars(count_inc)
-                loss, aux, new_params, new_slots, gnorm = \
-                    overlap.microbatch_grads(
-                        loss_fn, state.params, batch, mesh,
-                        microbatches=microbatches,
-                        bucket_bytes=state.tx.bucket_bytes,
-                        reduce_op=reduce_op, has_aux=True,
-                        param_specs=param_specs, hierarchy=hierarchy,
-                        gather=gather, prefetch=prefetch,
-                        fused=state.tx,
-                        opt_slots=state.opt_state["slots"],
-                        opt_scal=scal)
+                outs = overlap.microbatch_grads(
+                    loss_fn, state.params, batch, mesh,
+                    microbatches=microbatches,
+                    bucket_bytes=state.tx.bucket_bytes,
+                    reduce_op=reduce_op, has_aux=True,
+                    param_specs=param_specs, hierarchy=hierarchy,
+                    gather=gather, prefetch=prefetch,
+                    fused=state.tx,
+                    opt_slots=state.opt_state["slots"],
+                    opt_scal=scal, quant_amax=qamax)
+                loss, aux, new_params, new_slots, gnorm = outs[:5]
                 new_state = state.replace(
                     step=state.step + 1, params=new_params,
                     opt_state={"count": count_inc, "slots": new_slots})
+                if quant:
+                    new_state = new_state.replace(
+                        quant_state={"amax": outs[5]})
                 return new_state, {"loss": loss, "grad_norm": gnorm,
                                    "aux_loss": aux}
 
-            loss, aux, grads = overlap.microbatch_grads(
+            outs = overlap.microbatch_grads(
                 loss_fn, state.params, batch, mesh,
                 microbatches=microbatches, bucket_bytes=bucket_bytes,
                 reduce_op=reduce_op, has_aux=True,
                 param_specs=param_specs, hierarchy=hierarchy,
-                gather=gather, prefetch=prefetch)
+                gather=gather, prefetch=prefetch, quant_amax=qamax)
+            loss, aux, grads = outs[:3]
             # ZeRO-3: grads carry the fsdp shard layout here, so the
             # optimizer update and the norm reduction below run shard-
             # local with GSPMD inserting only the tiny norm psum.
             new_state = state.apply_gradients(grads=grads)
+            if quant:
+                new_state = new_state.replace(
+                    quant_state={"amax": outs[3]})
             gnorm = optax.global_norm(grads)
             return new_state, {"loss": loss, "grad_norm": gnorm,
                                "aux_loss": aux}
@@ -364,6 +387,23 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                     f"disagrees with the FusedOptimizer's "
                     f"{state.tx.bucket_bytes} — the tx's value sized the "
                     f"bucket-resident opt state and wins; set it there")
+        if quant:
+            from tony_tpu.ops import quant as quant_mod
+
+            if not quant_mod.is_quant_state(state):
+                raise ValueError(
+                    "quant=True needs a QuantTrainState carrying the "
+                    "delayed-scaling amax state — attach it with "
+                    "tony_tpu.ops.quant.with_gather_quant(state, mesh)")
+            bb = state.tx.bucket_bytes if update == "fused_bucket" \
+                else bucket_bytes
+            if state.qconfig.bucket_bytes != bb:
+                raise ValueError(
+                    f"quant=True: the state's QuantConfig.bucket_bytes="
+                    f"{state.qconfig.bucket_bytes} disagrees with the "
+                    f"step's {bb} — the amax histories were sized for a "
+                    f"different bucket plan; rebuild with "
+                    f"with_gather_quant(bucket_bytes={bb})")
         with mesh_context(mesh):
             return _jitted_for(state)(state, batch)
 
@@ -387,7 +427,7 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                 "gather": gather, "reduce_op": reduce_op,
                 "hierarchy": hierarchy, "donate": donate,
                 "microbatches": microbatches, "bucket_bytes": bb,
-                "param_specs": param_specs,
+                "param_specs": param_specs, "quant": quant,
                 "fused": state.tx if update == "fused_bucket" else None}
 
     stepper.inspect = inspect
