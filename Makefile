@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -67,6 +67,16 @@ tier1-quant:
 # seeded violation, committed step-signature pins, source lint.
 tier1-analysis:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'analysis and not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Serving-plane marker leg — paged KV cache invariants, flash-decoding
+# kernel-vs-fallback bit pin, the continuous-batching BITWISE
+# decode-vs-full-prefill pin, bf16 restore dtype policy, serve
+# heartbeat/autoscale control plane. Runs the FULL serve selection
+# (slow included): the train→ckpt→replica e2e is slow-marked to keep
+# tier1-verify inside its timeout, but this named leg is the lane's
+# gate and must see it.
+tier1-serve:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The jnp.concatenate/stack pack-site lint (the jax-0.4 GSPMD concat-
 # reshard footgun, machine-checked): every call site outside the approved

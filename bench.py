@@ -238,6 +238,19 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["quant_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        # Serving-plane leg (tony_tpu.serve): continuous vs static
+        # batching under one Poisson arrival trace — tokens/s, p50/p99
+        # request latency, and the token-identity gate (continuous
+        # batching must be bit-transparent). CPU numbers measure engine
+        # scheduling, not TPU decode (serve_sim_note); BENCH_r12.
+        try:
+            from tony_tpu.benchmark import run_serve_bench
+            result.update(run_serve_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["serve_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

@@ -47,6 +47,7 @@ _TIER_BY_MODULE = {
     "test_pipeline": "jit", "test_overlap": "jit", "test_multislice": "jit",
     "test_sched": "jit",
     "test_analysis": "jit",
+    "test_serve": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

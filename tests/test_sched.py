@@ -272,6 +272,7 @@ class TestReportAliasing:
          profiler.reset_collective_records),
         ("update", profiler.update_report, profiler.reset_update_records),
         ("quant", profiler.quant_report, profiler.reset_quant_records),
+        ("serve", profiler.serve_report, profiler.reset_serve_records),
         ("analysis", profiler.analysis_report,
          profiler.reset_analysis_records),
     ])

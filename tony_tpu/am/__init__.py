@@ -290,6 +290,60 @@ class ApplicationMaster:
                     c.exit_code if c.exit_code else constants.EXIT_FAILURE,
                     f"executor exited with {c.exit_code} without reporting")
 
+    def _autoscale_serve(self, session: TonySession) -> None:
+        """Heartbeat-driven replica scaling for the ``serve`` job type
+        (tony_tpu.serve): feed the replicas' piggybacked qps/p99/queue-
+        depth into the pure :func:`tony_tpu.serve.scaling.decide` policy
+        and apply the delta — launch an ELASTIC task on scale-up, retire
+        the newest elastic replica on scale-down (the conf-declared
+        floor is untouchable). Autoscale is off unless the conf raises
+        ``tony.serve.replicas.max`` above the static instance count.
+        Only runs after the gang barrier: the initial gang must seal its
+        cluster spec before membership gets elastic."""
+        jt = constants.SERVE
+        if jt not in self.conf.job_types():
+            return
+        if self.handler is None or not self.handler._all_registered_fired:
+            return
+        from tony_tpu.serve import scaling    # jax-free
+
+        if not hasattr(self, "_serve_policy"):
+            self._serve_policy = scaling.ScalingPolicy.from_conf(
+                self.conf, self.conf.instances(jt))
+            self._serve_scale_last: Optional[float] = None
+        policy = self._serve_policy
+        live = [t for t in session.tasks()
+                if t.job_type == jt and not t.status.is_terminal]
+        # Floor REPAIR runs even when autoscale is off: `tony serve`
+        # disables fail-fast on the promise that a crashed replica gets
+        # replaced, so below-floor recovery must not hide behind the
+        # max>min autoscale arming.
+        if not policy.enabled and len(live) >= policy.min_replicas:
+            return
+        now = time.monotonic()
+        delta = scaling.decide(policy, len(live), session.serve_samples(jt),
+                               now=now, last_action=self._serve_scale_last)
+        if delta > 0:
+            for _ in range(delta):
+                task = session.add_task(jt)
+                self._log(f"serve scale-up -> launching elastic replica "
+                          f"{task.task_id} ({len(live) + 1} live)")
+                self._try_launch(session, jt, task.index)
+            self._serve_scale_last = now
+        elif delta < 0:
+            victims = sorted((t for t in live if t.elastic),
+                             key=lambda t: t.index, reverse=True)
+            if victims:
+                victim = victims[0]
+                self._log(f"serve scale-down -> retiring elastic replica "
+                          f"{victim.task_id} ({len(live) - 1} live)")
+                session.mark_scaled_down(
+                    victim, "replica scale-down (load below floor)")
+                c = self._containers.get(victim.task_id)
+                if c is not None and c.is_running:
+                    self.scheduler.stop_container(c)
+                self._serve_scale_last = now
+
     def _collect_traces_later(self, session: TonySession,
                               delay_s: float) -> None:
         """Wait for the executors' profiler endpoints to arrive (they're
@@ -415,6 +469,7 @@ class ApplicationMaster:
 
                 self._handle_completed_containers(session)
                 self._check_heartbeats(session)
+                self._autoscale_serve(session)
                 self._maybe_refresh_credentials()
 
                 if self._stop_reason is not None:

@@ -51,6 +51,7 @@ from typing import Any
 __all__ = [
     "AnalysisReport", "CollectiveEqn", "Expected", "Finding",
     "SCALAR_NBYTES", "Waiver", "analyze_accum_step", "analyze_jaxpr",
+    "analyze_serve_step",
     "apply_waivers", "check_signature", "collect_collectives",
     "diff_signature", "expected_accum_collectives", "live_high_water",
     "step_signature",
@@ -59,7 +60,7 @@ __all__ = [
 # name -> owning submodule (None = the name IS a submodule).
 _LAZY = {
     "AnalysisReport": "core", "analyze_accum_step": "core",
-    "analyze_jaxpr": "core",
+    "analyze_jaxpr": "core", "analyze_serve_step": "core",
     "CollectiveEqn": "jaxprwalk", "collect_collectives": "jaxprwalk",
     "live_high_water": "jaxprwalk",
     "Expected": "rules", "Finding": "rules", "SCALAR_NBYTES": "rules",

@@ -27,9 +27,10 @@ from tony_tpu.analysis.signature import (check_signature, diff_signature,
 
 __all__ = [
     "AnalysisReport", "CollectiveEqn", "Expected", "Finding", "Waiver",
-    "analyze_accum_step", "analyze_jaxpr", "apply_waivers",
-    "check_signature", "collect_collectives", "diff_signature",
-    "expected_accum_collectives", "live_high_water", "step_signature",
+    "analyze_accum_step", "analyze_jaxpr", "analyze_serve_step",
+    "apply_waivers", "check_signature", "collect_collectives",
+    "diff_signature", "expected_accum_collectives", "live_high_water",
+    "step_signature",
 ]
 
 # Trace-time side channel into the profiler registry (shared shim
@@ -136,6 +137,80 @@ def analyze_jaxpr(closed: Any, *, expected: Sequence[Expected] = (),
         collectives=tuple(colls),
         signature=step_signature(closed, donated, collectives=colls),
         config=dict(config or {}))
+    _bank(report)
+    return report
+
+
+def analyze_serve_step(engine: Any, *, waivers: Sequence[Waiver] = (),
+                       tag: str = "serve",
+                       signature_path: Optional[str] = None,
+                       batch: Optional[int] = None) -> AnalysisReport:
+    """Analyze a :class:`tony_tpu.serve.ServeEngine` decode step — the
+    serving plane's day-one planner registration made auditable.
+
+    Uses the engine's ``decode_traced`` hook (the same jit the loop
+    runs) and reconciles the traced program against the engine's
+    planner-registered expected collective set — which is EMPTY: a
+    replica's decode must issue zero inter-chip collectives (its mesh
+    shards memory, never cross-replica math), so any GSPMD-inserted
+    reshard/gather surfaces as a rule-2 finding, not a latency mystery.
+    Dtype policy (rule 3) and donation (rule 4 — the KV pools must be
+    donated or every step doubles the cache's residency) run as on the
+    accum steps; ``signature_path`` pins the digest (rule 5)."""
+    jitted, args = engine.decode_traced(batch)
+    traced = jitted.trace(*args)
+    closed = traced.jaxpr
+    donate_argnums = tuple(getattr(traced, "donate_argnums", ()) or ())
+    donated = _donated_flags(args, donate_argnums)
+    if len(donated) != len(closed.jaxpr.invars):
+        donated = None                    # static args shifted the map
+    colls, findings = _jaxpr_findings(
+        closed, expected=engine.expected_collectives(), gplan=None,
+        gather="bucketed", state=None)
+    # Donation (rule 4), flat-aware: traced.donate_argnums indexes FLAT
+    # invars here (params flattens ahead of the pools), so resolve each
+    # pool argument's flat span and require every position donated.
+    arg_names = ("params", "pool_k", "pool_v", "tokens", "positions",
+                 "tables", "flat_idx")
+    spans = []
+    pos = 0
+    for a in args:
+        n = len(jax.tree_util.tree_leaves(a))
+        spans.append((pos, pos + n))
+        pos += n
+    donated_set = set(donate_argnums)
+    for argnum in (1, 2):
+        lo, hi = spans[argnum]
+        if not all(i in donated_set for i in range(lo, hi)):
+            nbytes = sum(jaxprwalk.aval_nbytes(l) for l in
+                         jax.tree_util.tree_leaves(args[argnum]))
+            findings.append(Finding(
+                rule="donation", kind="undonated_argument",
+                severity="error",
+                message=(f"argument {argnum} ({arg_names[argnum]!r}, "
+                         f"{nbytes} B) is not donated — every decode "
+                         f"step would double the KV pool's residency"),
+                provenance=f"donate_argnums={donate_argnums}"))
+    sig = step_signature(closed, donated, collectives=colls)
+    if signature_path is not None:
+        for line in check_signature(sig, signature_path):
+            findings.append(Finding(
+                rule="signature", kind="signature_drift",
+                severity="error",
+                message=f"step signature drifted from the committed pin: "
+                        f"{line}",
+                provenance=str(signature_path)))
+    active, waived = apply_waivers(findings, waivers)
+    config = {
+        "plane": "serve_decode", "ctx_pad": engine.ctx_pad,
+        "block_size": engine.block_size, "q_block": engine.q_block,
+        "n_blocks": engine.cache.n_blocks,
+        "decode_buckets": list(engine.decode_buckets),
+        "donate_argnums": list(donate_argnums),
+    }
+    report = AnalysisReport(
+        tag=tag, findings=tuple(active), waived=tuple(waived),
+        collectives=tuple(colls), signature=sig, config=config)
     _bank(report)
     return report
 

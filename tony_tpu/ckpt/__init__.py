@@ -91,7 +91,7 @@ def decode_portable(tree: Any, mesh: Optional[Any] = None) -> Any:
 # compute stack, and `import tony_tpu.ckpt` must keep that property.
 _LAZY = {
     "adapt_spec": "restore", "restore_latest": "restore",
-    "restore_pytree": "restore",
+    "restore_pytree": "restore", "find_path_prefix": "restore",
     "AsyncCheckpointer": "snapshot", "Snapshot": "snapshot",
     "extract_snapshot": "snapshot", "write_snapshot": "snapshot",
 }

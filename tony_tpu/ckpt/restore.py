@@ -102,9 +102,40 @@ def _assemble(reader: fmt.ChunkReader, leaf_idx: int, dtype: np.dtype,
     return out
 
 
+# Restore-time dtype policies (f32 master → serving dtype, applied
+# during shard assembly so the wide master copy never reaches a device):
+# policy name → the dtype float leaves cast to.
+DTYPE_POLICIES: Dict[str, str] = {"bf16": "bfloat16", "f32": "float32"}
+
+# Leaves the policy NEVER touches: optimizer slots (optax state and the
+# fused plane's portable leaf-major form both live under .opt_state) and
+# the quant lane's delayed-scaling state — numerically load-bearing f32
+# that a serving cast would silently corrupt on the next fine-tune.
+POLICY_EXEMPT_MARKERS: tuple = (".opt_state", ".quant_state")
+
+
+def _apply_dtype_policy(policy: Optional[str], path: str,
+                        dtype: np.dtype) -> np.dtype:
+    """The dtype a leaf at ``path`` assembles into under ``policy``:
+    float leaves cast to the policy dtype, optimizer/scale state and
+    non-float leaves (tokens, counters, bools) keep their own."""
+    if policy is None:
+        return dtype
+    if policy not in DTYPE_POLICIES:
+        raise ValueError(f"unknown dtype_policy {policy!r} "
+                         f"(one of {sorted(DTYPE_POLICIES)})")
+    if any(m in path for m in POLICY_EXEMPT_MARKERS):
+        return dtype
+    import jax.numpy as jnp
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return dtype
+    return fmt.dtype_from_name(DTYPE_POLICIES[policy])
+
+
 def _restore_leaf(reader: fmt.ChunkReader, leaf_idx: int,
                   meta: Dict[str, Any], target: Any,
-                  mesh: Optional[Mesh]) -> Any:
+                  mesh: Optional[Mesh],
+                  dtype_policy: Optional[str] = None) -> Any:
     global_shape = tuple(meta["shape"])
     saved_dtype = fmt.dtype_from_name(meta["dtype"])
     t_shape = tuple(np.shape(target)) if not isinstance(
@@ -117,6 +148,7 @@ def _restore_leaf(reader: fmt.ChunkReader, leaf_idx: int,
     dtype = np.dtype(getattr(target, "dtype", saved_dtype))
     if hasattr(dtype, "name"):
         dtype = fmt.dtype_from_name(dtype.name)   # normalize ml_dtypes
+    dtype = _apply_dtype_policy(dtype_policy, meta["path"], dtype)
 
     sharding = getattr(target, "sharding", None)
     if sharding is None and mesh is not None:
@@ -153,13 +185,27 @@ def _restore_leaf(reader: fmt.ChunkReader, leaf_idx: int,
 
 def restore_pytree(root: str | Path, target: Any, *,
                    step: Optional[int] = None, mesh: Optional[Mesh] = None,
-                   verify: bool = True, strict: bool = True) -> Any:
+                   verify: bool = True, strict: bool = True,
+                   dtype_policy: Optional[str] = None,
+                   path_prefix: str = "") -> Any:
     """Restore ``target``'s array leaves from the committed checkpoint at
     ``step`` (default: newest). ``target`` supplies structure, statics,
     dtypes, and — when its leaves carry committed shardings — the exact
     output layout; ``mesh`` supplies the layout for shardingless targets
     (manifest specs mapped through :func:`adapt_spec`). ``strict`` raises
-    when an array leaf has no manifest entry (else it passes through)."""
+    when an array leaf has no manifest entry (else it passes through).
+
+    ``dtype_policy`` is the serving plane's restore-time cast
+    (``"bf16"``: f32 master → bf16, applied per-shard DURING assembly so
+    the wide copy never reaches a device; optimizer/scale state is never
+    cast — see :data:`POLICY_EXEMPT_MARKERS`). ``path_prefix`` restores
+    a SUBTREE of a larger manifest: target leaf paths are looked up as
+    ``path_prefix + path`` (e.g. ``".params"`` pulls just the params out
+    of a full-TrainState checkpoint — the replica's restore, which wants
+    no optimizer slots resurrected at all). Use
+    :func:`find_path_prefix` to locate the prefix in a manifest whose
+    wrapping (bare state vs train_loop's ``{"model": ...}``) is
+    unknown."""
     if step is None:
         step = fmt.latest_step(root)
         if step is None:
@@ -171,6 +217,7 @@ def restore_pytree(root: str | Path, target: Any, *,
     out = []
     with fmt.ChunkReader(root, step, manifest, verify=verify) as reader:
         for path, leaf in zip(paths, leaves):
+            path = path_prefix + path
             if path not in by_path:
                 if strict and _is_saveable(leaf) and np.ndim(leaf) > 0:
                     raise KeyError(
@@ -180,14 +227,59 @@ def restore_pytree(root: str | Path, target: Any, *,
                 out.append(leaf)
                 continue
             idx, meta = by_path[path]
-            out.append(_restore_leaf(reader, idx, meta, leaf, mesh))
+            out.append(_restore_leaf(reader, idx, meta, leaf, mesh,
+                                     dtype_policy))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def find_path_prefix(root: str | Path, target: Any, *,
+                     step: Optional[int] = None) -> str:
+    """The ``path_prefix`` under which ``target``'s leaves live in the
+    committed manifest — resolves a bare params tree against whatever
+    wrapping wrote the checkpoint (a raw params save → ``""``, a
+    TrainState → ``".params"``, train_loop's wrapped payload →
+    ``"['model'].params"``). Raises ``KeyError`` when no prefix covers
+    every array leaf."""
+    if step is None:
+        step = fmt.latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no committed checkpoint under {root}")
+    manifest = fmt.read_manifest(root, step)
+    mpaths = {m["path"] for m in manifest["leaves"]}
+    paths, leaves, _ = leaf_paths(target)
+    needed = [p for p, l in zip(paths, leaves)
+              if _is_saveable(l) and np.ndim(l) > 0]
+    if not needed:
+        return ""
+    probe = needed[0]
+    candidates = []
+    for mp in sorted(mpaths):
+        if not mp.endswith(probe):
+            continue
+        prefix = mp[:len(mp) - len(probe)]
+        if all(prefix + p in mpaths for p in needed):
+            candidates.append(prefix)
+    if not candidates:
+        raise KeyError(
+            f"no manifest path prefix covers the target's leaves (probe "
+            f"{probe!r}; manifest has {len(mpaths)} leaves) — is this "
+            f"checkpoint for a different model?")
+    # Ambiguity is real: adamw's mu/nu trees mirror the params' leaf
+    # paths exactly, so ".opt_state[0].mu" covers a bare params target
+    # too. Prefer prefixes OUTSIDE the derived-state subtrees (optimizer
+    # slots / quant scale state are never the tree a restore should seed
+    # from), shortest first.
+    primary = [c for c in candidates
+               if not any(m in c for m in POLICY_EXEMPT_MARKERS)]
+    return min(primary or candidates, key=len)
+
+
 def restore_latest(root: str | Path, target: Any, *,
-                   mesh: Optional[Mesh] = None, verify: bool = True) -> Any:
+                   mesh: Optional[Mesh] = None, verify: bool = True,
+                   dtype_policy: Optional[str] = None) -> Any:
     """``restore_pytree`` when a committed step exists, else ``target``
     unchanged — the first-attempt no-op the gang-restart contract needs."""
     if fmt.latest_step(root) is None:
         return target
-    return restore_pytree(root, target, mesh=mesh, verify=verify)
+    return restore_pytree(root, target, mesh=mesh, verify=verify,
+                          dtype_policy=dtype_policy)

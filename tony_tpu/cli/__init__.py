@@ -65,6 +65,50 @@ def cmd_submit(args: argparse.Namespace) -> int:
     return client.run(timeout=args.timeout)
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Submit an online-serving job (tony_tpu.serve): N replica
+    containers, each restoring the training checkpoint onto its own
+    mesh (bf16 dtype policy by default) and running the continuous-
+    batching engine behind the control-plane RPC wire. ``--max_replicas``
+    above ``--replicas`` arms the AM's heartbeat-driven autoscaler."""
+    import json as json_mod
+    from pathlib import Path
+
+    from tony_tpu.client import TonyClient
+
+    cfg = TonyConfig()
+    if args.conf_file:
+        cfg.merge_file(args.conf_file)
+    # Replicas are independent jax worlds — no rendezvous gang — so the
+    # framework is "standalone"; and a serving fleet should outlive one
+    # crashed replica, so fail-fast is off (the autoscaler repairs the
+    # floor instead).
+    cfg.set(conf_mod.APPLICATION_FRAMEWORK, "standalone")
+    cfg.set(conf_mod.APPLICATION_NAME,
+            args.name or f"tony-serve-{args.model}")
+    cfg.set(conf_mod.APPLICATION_STOP_ON_FAILURE, "false")
+    cfg.set(conf_mod.instances_key("serve"), str(args.replicas))
+    cfg.set(conf_mod.command_key("serve"),
+            "python -m tony_tpu.serve.replica")
+    cfg.set(conf_mod.SERVE_MODEL, args.model)
+    if args.model_kwargs:
+        json_mod.loads(args.model_kwargs)   # validate at submit, not launch
+        cfg.set(conf_mod.SERVE_MODEL_KWARGS, args.model_kwargs)
+    # Absolute: replicas run with a different cwd.
+    cfg.set(conf_mod.SERVE_CKPT_DIR, str(Path(args.ckpt_dir).resolve()))
+    cfg.set(conf_mod.SERVE_DTYPE_POLICY, args.dtype_policy)
+    cfg.set(conf_mod.SERVE_CTX_MAX, str(args.ctx_max))
+    if args.mesh:
+        json_mod.loads(args.mesh)
+        cfg.set(conf_mod.SERVE_MESH, args.mesh)
+    if args.max_replicas is not None:
+        cfg.set(conf_mod.SERVE_REPLICAS_MAX, str(args.max_replicas))
+    cfg.merge_overrides(_parse_conf_overrides(args.conf or []))
+    client = TonyClient(cfg, workdir=args.workdir, am_host=args.am_host,
+                        quiet=args.quiet)
+    return client.run(timeout=args.timeout)
+
+
 def cmd_history(args: argparse.Namespace) -> int:
     from tony_tpu.history import main as history_main
     return history_main(args)
@@ -250,6 +294,35 @@ def make_parser() -> argparse.ArgumentParser:
                    help="client-side monitor timeout in seconds")
     s.add_argument("--quiet", action="store_true")
     s.set_defaults(fn=cmd_submit)
+
+    sv = sub.add_parser("serve", help="serve a trained checkpoint: replica "
+                        "containers with continuous batching and "
+                        "heartbeat-driven autoscale")
+    sv.add_argument("--model", required=True,
+                    help="registered model name (e.g. llama2-7b)")
+    sv.add_argument("--model_kwargs", help="JSON dict of model kwargs "
+                    "(quant lanes, layer count overrides, ...)")
+    sv.add_argument("--ckpt_dir", required=True,
+                    help="training checkpoint directory to serve")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="initial replica count (the autoscale floor)")
+    sv.add_argument("--max_replicas", type=int, default=None,
+                    help="autoscale ceiling (> --replicas arms the "
+                         "AM's heartbeat-driven scaler)")
+    sv.add_argument("--dtype_policy", default="bf16", choices=("bf16", "f32"),
+                    help="restore-time cast: f32 master -> serving dtype")
+    sv.add_argument("--ctx_max", type=int, default=2048,
+                    help="max positions per sequence (KV buffer extent)")
+    sv.add_argument("--mesh", help="JSON MeshSpec kwargs for each "
+                    "replica's own mesh (e.g. '{\"fsdp\": 2}')")
+    sv.add_argument("--conf_file", help="tony.xml / JSON job config")
+    sv.add_argument("--conf", action="append", metavar="KEY=VALUE")
+    sv.add_argument("--name", help="application name")
+    sv.add_argument("--workdir", help="client work dir")
+    sv.add_argument("--am_host", default="127.0.0.1")
+    sv.add_argument("--timeout", type=float, default=None)
+    sv.add_argument("--quiet", action="store_true")
+    sv.set_defaults(fn=cmd_serve)
 
     h = sub.add_parser("history", help="list jobs or show one job's events")
     h.add_argument("action", choices=["list", "show", "serve"],

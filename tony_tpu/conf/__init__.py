@@ -102,6 +102,24 @@ CKPT_KEEP = "tony.ckpt.keep"              # committed steps retained (def. 3)
 # gang — derives the identical stream; the per-host shard comes from the
 # rendezvous identity, not from conf.
 DATA_SEED = "tony.data.seed"
+
+# -- serving plane (tony_tpu.serve; the `tony serve` CLI writes these,
+# the replica process and the AM's replica autoscaler read them) --------
+SERVE_MODEL = "tony.serve.model"                # registered model name
+SERVE_MODEL_KWARGS = "tony.serve.model-kwargs"  # JSON dict of model kwargs
+SERVE_CKPT_DIR = "tony.serve.ckpt-dir"          # training ckpt to serve
+SERVE_DTYPE_POLICY = "tony.serve.dtype-policy"  # bf16 (default) | f32
+SERVE_CTX_MAX = "tony.serve.ctx-max"            # max positions per sequence
+SERVE_BLOCK_SIZE = "tony.serve.block-size"      # KV pool block size
+SERVE_MAX_RUNNING = "tony.serve.max-running"    # max joined batch
+SERVE_MESH = "tony.serve.mesh"                  # JSON MeshSpec kwargs
+SERVE_PORT = "tony.serve.port"                  # replica RPC port (0=any)
+SERVE_REPLICAS_MIN = "tony.serve.replicas.min"  # autoscale floor
+SERVE_REPLICAS_MAX = "tony.serve.replicas.max"  # autoscale ceiling
+SERVE_QUEUE_HIGH = "tony.serve.scale.queue-high"
+SERVE_QUEUE_LOW = "tony.serve.scale.queue-low"
+SERVE_P99_HIGH_MS = "tony.serve.scale.p99-high-ms"
+SERVE_COOLDOWN_S = "tony.serve.scale.cooldown-s"
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

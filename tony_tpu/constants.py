@@ -51,6 +51,11 @@ ENV_CKPT_KEEP = "TONY_CKPT_KEEP"
 # (TONY_PROCESS_ID/TONY_NUM_PROCESSES) with the generic executor pair
 # (TONY_TASK_INDEX/TONY_NUM_TASKS) as fallback.
 ENV_DATA_SEED = "TONY_DATA_SEED"
+# Serving plane (tony_tpu.serve): the executor exports a per-container
+# stats-file path; the replica's engine publishes qps/p99/queue-depth
+# there and the executor's heartbeat loop piggybacks it to the AM (both
+# sides jax-free), where the replica autoscaler reads it.
+ENV_SERVE_STATS = "TONY_SERVE_STATS"
 
 # TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
 ENV_TF_CONFIG = "TF_CONFIG"
@@ -109,6 +114,7 @@ TENSORBOARD = "tensorboard"
 NOTEBOOK = "notebook"
 DRIVER = "driver"               # Horovod-style driver task
 SCHEDULER = "scheduler"         # MXNet kvstore scheduler
+SERVE = "serve"                 # online-serving replica (tony_tpu.serve)
 
 # Job types whose completion drives the "chief done => job done" policy.
 CHIEF_LIKE_JOB_TYPES = (CHIEF, MASTER)

@@ -82,6 +82,19 @@ def _link_tree(src: Path, dest: Path, symlinks: bool = False) -> None:
     shutil.copytree(src, dest, symlinks=symlinks, copy_function=_link)
 
 
+def read_serve_stats(path: str | Path) -> Optional[Dict[str, float]]:
+    """The replica engine's published telemetry (qps/p99_ms/queue_depth
+    — see ``ServeEngine.write_stats``), or None. Jax-free and
+    failure-silent by contract: this rides the heartbeat loop, and a
+    torn/absent/garbage stats file must never sink liveness."""
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+        return {str(k): float(v) for k, v in dict(raw).items()}
+    except Exception:   # noqa: BLE001 — advisory telemetry only
+        return None
+
+
 def reserve_port(host: str = "") -> socket.socket:
     """Bind a listening socket on an ephemeral port and keep it open —
     the reference's ServerSocket reservation. Caller closes just before the
@@ -188,6 +201,13 @@ class TaskExecutor:
         self._hb_stop = threading.Event()
 
     # -- pieces ------------------------------------------------------------
+    def serve_stats_path(self) -> Path:
+        """The per-container serving-telemetry file: the executor
+        exports this path (``TONY_SERVE_STATS``) into the user env, a
+        serve replica's engine publishes into it, and the heartbeat
+        loop piggybacks whatever appears there to the AM."""
+        return self.log_dir / "serve-stats.json"
+
     def user_command(self) -> str:
         cmd = (self.conf.get(conf_mod.command_key(self.job_type))
                or self.conf.get("tony.application.executes"))
@@ -306,6 +326,7 @@ class TaskExecutor:
         hb_client = RpcClient(self.am_address, token=self.token,
                               timeout=max(1.0, interval_s))
         ckpt_dir = self.conf.get(conf_mod.CKPT_DIR) or None
+        serve_stats_path = self.serve_stats_path()
 
         def ckpt_step() -> Optional[int]:
             if not ckpt_dir:
@@ -323,10 +344,15 @@ class TaskExecutor:
             while not self._hb_stop.wait(interval_s):
                 try:
                     step = ckpt_step()
+                    serve = read_serve_stats(serve_stats_path) \
+                        if serve_stats_path.is_file() else None
+                    extras: Dict[str, object] = {}
+                    if step is not None:
+                        extras["ckpt_step"] = step
+                    if serve is not None:
+                        extras["serve"] = serve
                     hb_client.call("heartbeat", job_type=self.job_type,
-                                   index=self.index,
-                                   **({"ckpt_step": step}
-                                      if step is not None else {}))
+                                   index=self.index, **extras)
                     failures = 0
                     if self._am_lost and self.user_proc is None:
                         # The AM was only transiently unreachable (e.g. a
@@ -461,6 +487,8 @@ class TaskExecutor:
             env = dict(os.environ)
             env.update(self._venv_env(self.localize_venv()))
             env.update(task_env)
+            env[constants.ENV_SERVE_STATS] = str(
+                self.serve_stats_path().resolve())
             if self.token:
                 env[ENV_JOB_TOKEN] = self.token
             cwd = str(src) if src else os.getcwd()

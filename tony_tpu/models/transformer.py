@@ -118,14 +118,23 @@ class TransformerConfig:
 
 def rope(x: jax.Array, positions: jax.Array, theta: float,
          seq_axis: int = 2) -> jax.Array:
-    """Rotary embedding with positions [T]; the sequence dim sits at
+    """Rotary embedding with positions [T] (shared across the batch) or
+    [B, T] (per-sequence absolute positions — the serving plane's decode
+    rows sit at different depths per sequence); the sequence dim sits at
     ``seq_axis`` (2 for [B, H, T, D], 1 for the packed [B, T, H, D])."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
-    shape = [1] * x.ndim
-    shape[seq_axis] = angles.shape[0]
-    shape[-1] = d // 2
+    if positions.ndim == 2:
+        angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,D/2]
+        shape = [1] * x.ndim
+        shape[0] = angles.shape[0]
+        shape[seq_axis] = angles.shape[1]
+        shape[-1] = d // 2
+    else:
+        angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+        shape = [1] * x.ndim
+        shape[seq_axis] = angles.shape[0]
+        shape[-1] = d // 2
     cos = jnp.cos(angles).reshape(shape)
     sin = jnp.sin(angles).reshape(shape)
     x1, x2 = x[..., ::2], x[..., 1::2]
@@ -170,7 +179,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, kv=None):
         cfg = self.cfg
         b, t, _ = x.shape
         hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -183,6 +192,41 @@ class Attention(nn.Module):
         q = dense(nh * hd, ("embed", "heads"), "wq", "qkv")(x)
         k = dense(nkv * hd, ("embed", "kv_heads"), "wk", "qkv")(x)
         v = dense(nkv * hd, ("embed", "kv_heads"), "wv", "qkv")(x)
+        if kv is not None:
+            # Serve-mode forward (tony_tpu.serve): the t rows are NEW
+            # tokens at per-sequence absolute ``positions`` [b, t]; the
+            # context lives in the gathered KV buffer [b, ctx, nkv·hd].
+            # The rows' post-rope k/v scatter into the buffer (so a row
+            # attends itself and everything the cache holds below its
+            # position), attention runs through the flash-decoding
+            # kernel, and the raw rows are returned for the engine to
+            # commit into the paged pool. Projections are the SAME
+            # denses as training — the quant= lanes ride along — so a
+            # training checkpoint serves without any param surgery.
+            k_buf, v_buf = kv
+            pos = positions.astype(jnp.int32)
+            q4 = rope(q.reshape(b, t, nh, hd), pos, cfg.rope_theta,
+                      seq_axis=1)
+            k4 = rope(k.reshape(b, t, nkv, hd), pos, cfg.rope_theta,
+                      seq_axis=1)
+            k_rows = k4.reshape(b, t, nkv * hd).astype(k_buf.dtype)
+            v_rows = v.astype(v_buf.dtype)
+            bidx = jnp.arange(b)[:, None]
+            # mode="drop": rows whose position falls off the buffer end
+            # (the trailing padding rows of a decode block near ctx_max)
+            # simply don't write.
+            k_buf = k_buf.at[bidx, pos].set(k_rows, mode="drop")
+            v_buf = v_buf.at[bidx, pos].set(v_rows, mode="drop")
+            ctx = k_buf.shape[1]
+            from tony_tpu.ops import flash_decode
+            out = flash_decode(
+                q4.transpose(0, 2, 1, 3),
+                k_buf.reshape(b, ctx, nkv, hd).transpose(0, 2, 1, 3),
+                v_buf.reshape(b, ctx, nkv, hd).transpose(0, 2, 1, 3),
+                pos)
+            out = out.transpose(0, 2, 1, 3).reshape(b, t, nh * hd)
+            return (dense(cfg.dim, ("heads", "embed"), "wo", "o")(out),
+                    (k_rows, v_rows))
         if (cfg.attention == "flash" and cfg.mesh is None
                 and hd % 128 == 0):
             # Packed layout: the kernel reads heads as lane offsets from
@@ -254,10 +298,14 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, kv=None):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions)
+        attn_out = Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), positions, kv=kv)
+        new_kv = None
+        if kv is not None:
+            attn_out, new_kv = attn_out
+        x = x + attn_out
         if cfg.moe_experts > 0:
             from tony_tpu.models.moe import MoEMLP
             mlp = MoEMLP(cfg.dim, cfg.ffn_hidden, cfg.moe_experts,
@@ -268,17 +316,23 @@ class Block(nn.Module):
         else:
             mlp = MLP(cfg, name="mlp")
         x = x + mlp(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        if kv is not None:
+            return x, new_kv
         return x
 
 
 class ScannedBlock(nn.Module):
     """Carry-signature wrapper so the layer stack folds into one
     ``nn.scan`` (single-block trace/compile, stacked params on a leading
-    ``stage`` axis)."""
+    ``stage`` axis). In serve mode the per-layer KV buffer arrives as a
+    scanned input and the freshly-written rows leave as the scan's
+    stacked ys."""
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, kv=None):
+        if kv is not None:
+            return Block(self.cfg, name="block")(x, positions, kv=kv)
         return Block(self.cfg, name="block")(x, positions), None
 
 
@@ -286,9 +340,24 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, targets=None):
+    def __call__(self, tokens, targets=None, *, positions=None, kv=None):
         cfg = self.cfg
         _b, t = tokens.shape
+        if kv is not None:
+            # Serve-mode forward (tony_tpu.serve.engine): tokens are a
+            # row block of NEW positions per sequence, context comes from
+            # the gathered KV buffers (one [b, ctx, nkv·hd] pair per
+            # layer, stacked on a leading layer axis), and the return is
+            # ``(logits, (k_rows, v_rows))`` for the engine to commit
+            # into its paged pool. Training traces are untouched: this
+            # branch only exists when the engine passes kv.
+            if targets is not None:
+                raise ValueError("serve-mode forward takes no targets")
+            if positions is None:
+                raise ValueError("serve-mode forward needs positions "
+                                 "[b, t] (per-sequence absolute)")
+            if cfg.moe_experts > 0:
+                raise ValueError("serve mode does not support MoE blocks")
         embed = self.param("embedding", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab, cfg.dim), jnp.float32)
@@ -326,7 +395,8 @@ class Transformer(nn.Module):
         else:
             x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-        positions = jnp.arange(t)
+        if positions is None:
+            positions = jnp.arange(t)
 
         block_cls = ScannedBlock
         # Validated OUTSIDE the remat gate: a typo'd (or remat=False-
@@ -344,18 +414,45 @@ class Transformer(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(block_cls, prevent_cse=False,
                                  policy=policy)
+        new_kv = None
         if cfg.scan_layers:
-            x, _ = nn.scan(
-                block_cls,
-                variable_axes={"params": 0, "losses": 0},
-                split_rngs={"params": True},
-                in_axes=nn.broadcast,
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: "stage"},
-            )(cfg, name="layers")(x, positions)
+            if kv is not None:
+                # The per-layer KV buffers ride the scan as a sliced
+                # input (in_axes 0 on the layer axis); the fresh rows
+                # come back as the stacked ys — no explicit jnp.stack,
+                # so no pack site.
+                x, new_kv = nn.scan(
+                    block_cls,
+                    variable_axes={"params": 0, "losses": 0},
+                    split_rngs={"params": True},
+                    in_axes=(nn.broadcast, 0),
+                    length=cfg.n_layers,
+                    metadata_params={nn.PARTITION_NAME: "stage"},
+                )(cfg, name="layers")(x, positions, kv)
+            else:
+                x, _ = nn.scan(
+                    block_cls,
+                    variable_axes={"params": 0, "losses": 0},
+                    split_rngs={"params": True},
+                    in_axes=nn.broadcast,
+                    length=cfg.n_layers,
+                    metadata_params={nn.PARTITION_NAME: "stage"},
+                )(cfg, name="layers")(x, positions)
         else:
-            for i in range(cfg.n_layers):
-                x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions)
+            if kv is not None:
+                ks, vs = [], []
+                for i in range(cfg.n_layers):
+                    x, (kr, vr) = block_cls(cfg, name=f"layer_{i}")(
+                        x, positions, jax.tree.map(lambda a: a[i], kv))
+                    ks.append(kr)
+                    vs.append(vr)
+                # packsite: region-local — stacking per-layer rows of one
+                # replica's serve forward along a NEW layer axis; all
+                # operands share one (replicated) sharding.
+                new_kv = (jnp.stack(ks), jnp.stack(vs))
+            else:
+                for i in range(cfg.n_layers):
+                    x, _ = block_cls(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if cfg.xent_chunk:
             # Fused head+loss: the kernel is hoisted to this scope (param
@@ -369,13 +466,18 @@ class Transformer(nn.Module):
             if targets is not None:
                 return chunked_next_token_xent(x, w, targets,
                                                cfg.xent_chunk, cfg.dtype)
-            return (x @ w.astype(cfg.dtype)).astype(jnp.float32)
+            logits = (x @ w.astype(cfg.dtype)).astype(jnp.float32)
+            if kv is not None:
+                return logits, new_kv
+            return logits
         # lm_head matmul in bf16 (an f32 matmul runs at a fraction of MXU
         # bf16 peak and this is ~2·dim·vocab FLOPs/token) — or int8 when
         # the "lm_head" quant lane is on; logits cast to f32 afterwards
         # for a stable softmax in the loss.
         logits = _proj_dense(cfg, "lm_head", cfg.vocab,
                              ("embed", "vocab"), "lm_head")(x)
+        if kv is not None:
+            return logits.astype(jnp.float32), new_kv
         return logits.astype(jnp.float32)
 
 

@@ -10,7 +10,7 @@ reference implementation used for CPU tests and as the autodiff backward.
 
 from tony_tpu.ops.attention import (
     flash_attention, flash_attention_packed, flash_attention_sharded,
-    reference_attention)
+    flash_decode, reference_attention)
 from tony_tpu.ops.fused_optim import (FusedOptimizer, fused_bucket_update,
                                       fused_update_step)
 from tony_tpu.ops.quant import (QuantConfig, QuantDense, QuantTrainState,
@@ -18,7 +18,8 @@ from tony_tpu.ops.quant import (QuantConfig, QuantDense, QuantTrainState,
                                 with_gather_quant)
 
 __all__ = ["flash_attention", "flash_attention_packed",
-           "flash_attention_sharded", "reference_attention",
+           "flash_attention_sharded", "flash_decode",
+           "reference_attention",
            "FusedOptimizer", "fused_bucket_update", "fused_update_step",
            "QuantConfig", "QuantDense", "QuantTrainState", "quant_dot",
            "quant_dot_general", "with_gather_quant"]

@@ -1142,6 +1142,194 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
     return out[:, :t, :]
 
 
+# --------------------------------------------------------------------
+# Flash decoding: the serving plane's attention (tony_tpu.serve). One
+# small q-block (the engine's fixed row block — a sublane tile of new
+# tokens) attends over a long cached K/V buffer, streamed in k-blocks
+# through the same online-softmax recurrence as the training kernels.
+# Forward-only (no vjp: serving never differentiates), masked by ABSOLUTE
+# positions (each row carries its own position — continuous batching puts
+# rows of different sequences, at different depths, in one launch).
+#
+# Numerics contract (the serve plane's decode-vs-prefill bit pin rides on
+# it): the pallas kernel and the pure-XLA fallback share one mask/update
+# expression (`_decode_mask_update`) and issue the same f32 dots in the
+# same per-block order, so they are bit-identical; and every op is
+# row-independent, so a row computes the same bits whether it rides a
+# prefill block, a decode block, or a differently-joined batch (the
+# engine keeps all row counts at sublane-tile multiples — single-row
+# GEMV paths are the one place XLA CPU breaks row invariance).
+# --------------------------------------------------------------------
+
+
+def _decode_mask_update(s, q_pos, k_pos, m, l):
+    """One online-softmax block step, shared verbatim by the pallas
+    kernel and the XLA fallback: mask scores by absolute position
+    (``k_pos <= q_pos`` — causal over the cache, which also hides
+    unwritten/garbage buffer tail positions), then fold the block into
+    the running (m, l) state. All f32; broadcasting carries the leading
+    batch dims of whichever caller."""
+    s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    return p, alpha, m_new, l_new
+
+
+def _decode_xla(q, k, v, q_positions, scale, block_k):
+    """Pure-XLA flash-decode fallback: fori_loop over k-blocks of the
+    cache, grouped [b, hkv, g·t, d] so GQA query heads batch onto their
+    kv head exactly like the kernel's head map."""
+    b, h, t, d = q.shape
+    hkv, ctx = k.shape[1], k.shape[2]
+    g = h // hkv
+    qf = q.astype(jnp.float32).reshape(b, hkv, g * t, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [b, 1, g·t, 1] absolute position per row (the g query heads of one
+    # kv head share their rows' positions).
+    q_pos = jnp.broadcast_to(
+        q_positions.astype(jnp.int32)[:, None, None, :],
+        (b, hkv, g, t)).reshape(b, hkv, g * t, 1)
+    nkb = ctx // block_k
+    m0 = jnp.full((b, hkv, g * t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g * t, 1), jnp.float32)
+    a0 = jnp.zeros((b, hkv, g * t, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, 2)
+        v_blk = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, 2)
+        s = jax.lax.dot_general(
+            qf, k_blk, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 3)
+        p, alpha, m_new, l_new = _decode_mask_update(s, q_pos, k_pos, m, l)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, a0))
+    out = acc / jnp.where(l > 0, l, 1.0)
+    return out.reshape(b, hkv, g, t, d).reshape(b, h, t, d).astype(q.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref, *, block_k: int,
+                   scale: float):
+    """One (batch, query-head) cell: q-block [t, d] against this kv
+    head's full cached [ctx, d] in VMEM, k-blocks streamed through the
+    shared online recurrence. Positions ride lane-replicated int32 (the
+    lse layout trick — a 1-D block would squeeze illegally on Mosaic)."""
+    t, d = q_ref.shape
+    ctx = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32)
+    q_pos = pos_ref[:, 0:1]
+
+    m0 = jnp.full((t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((t, 1), jnp.float32)
+    a0 = jnp.zeros((t, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        p, alpha, m_new, l_new = _decode_mask_update(s, q_pos, k_pos, m, l)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, ctx // block_k, body, (m0, l0, a0))
+    o_ref[:] = (acc / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, q_positions, scale, block_k, interpret):
+    b, h, t, d = q.shape
+    hkv, ctx = k.shape[1], k.shape[2]
+    reps = h // hkv
+    pos = jnp.broadcast_to(
+        q_positions.astype(jnp.int32)[:, :, None], (b, t, _LSE_LANES))
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=block_k, scale=scale),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((None, None, t, d), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, ctx, d),
+                         lambda bi, hi: (bi, hi // reps, 0, 0)),
+            pl.BlockSpec((None, None, ctx, d),
+                         lambda bi, hi: (bi, hi // reps, 0, 0)),
+            pl.BlockSpec((None, t, _LSE_LANES), lambda bi, hi: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, t, d),
+                               lambda bi, hi: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * t * ctx * d,
+            bytes_accessed=(k.size + v.size) * k.dtype.itemsize
+            + q.size * q.dtype.itemsize,
+            transcendentals=b * h * t * ctx),
+    )(q, k, v, pos)
+
+
+def flash_decode(q: jax.Array, k: jax.Array, v: jax.Array,
+                 q_positions: jax.Array, *, scale: Optional[float] = None,
+                 block_k: int = 128,
+                 interpret: Optional[bool] = None) -> jax.Array:
+    """Flash-decoding attention for the serving plane: a small q-block
+    ``[b, h, t, d]`` (t = the engine's row block) against a cached K/V
+    buffer ``[b, hkv, ctx, d]``, masked by each row's ABSOLUTE position
+    (``q_positions`` int32 ``[b, t]``: key j participates in row i iff
+    ``j <= q_positions[i]`` — causal over the cache, and unwritten buffer
+    tail positions are excluded for free because they sit above every
+    live row's position).
+
+    Dispatch mirrors :func:`flash_attention`: the pallas kernel on TPU
+    (``interpret=True`` for CPU test coverage), the pure-XLA fallback
+    elsewhere — the two are bit-identical (shared
+    :func:`_decode_mask_update`, same f32 dots in the same k-block
+    order), which the serve tests pin. GQA is zero-copy (query head h
+    reads kv head ``h·hkv/h``). Forward-only: serving never
+    differentiates through the cache.
+    """
+    if q.ndim != 4 or k.ndim != 4:
+        raise ValueError(f"flash_decode wants [b, h, t, d] q and "
+                         f"[b, hkv, ctx, d] k/v, got {q.shape}/{k.shape}")
+    b, h, t, d = q.shape
+    hkv, ctx = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads "
+                         f"{hkv}")
+    if k.shape != v.shape:
+        raise ValueError(f"k {k.shape} and v {v.shape} must match")
+    if q_positions.shape != (b, t):
+        raise ValueError(f"q_positions must be [b, t]={b, t}, got "
+                         f"{q_positions.shape}")
+    scale = d ** -0.5 if scale is None else scale
+    bk = _fit_block(block_k, ctx)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return _decode_xla(q, k, v, q_positions, scale, bk or ctx)
+        interpret = False
+    if not bk or t % 8 or d % 8 \
+            or not _resident_fits(ctx, d, k.dtype):
+        # Off-tile shapes / oversized caches leave the kernel path; the
+        # fallback is the same math (and bit-identical where both run).
+        _warn_fallback(
+            f"flash_decode shapes t={t} d={d} ctx={ctx} off the kernel "
+            f"tiles (or cache exceeds the VMEM budget)")
+        return _decode_xla(q, k, v, q_positions, scale, bk or ctx)
+    return _decode_pallas(q, k, v, q_positions, scale, bk, interpret)
+
+
 def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                             mesh, causal: bool = True,
                             scale: Optional[float] = None,

@@ -226,6 +226,33 @@ def reset_quant_records() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Serving-plane instrumentation (tony_tpu.serve): the engine records its
+# build-time geometry (context extent, block pool size, row block,
+# decode buckets, join policy) under the engine tag and its live
+# telemetry — the heartbeat triple qps/p99/queue-depth plus rates — under
+# "<tag>_stats"; the replica banks restore geometry under "replica".
+# Keyed by tag; last record per tag wins. run_serve_bench serializes
+# this next to the other records (BENCH_r12).
+SERVE_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_serve(tag: str, /, **fields) -> None:
+    """Bank one serving-plane record (engine geometry, qps/p50/p99/
+    queue-depth telemetry, replica restore geometry...)."""
+    SERVE_RECORDS[tag] = dict(fields)
+
+
+def serve_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded serving-plane entry (deep-copied via
+    :func:`_snapshot` — same aliasing contract as the other reports)."""
+    return _snapshot(SERVE_RECORDS)
+
+
+def reset_serve_records() -> None:
+    SERVE_RECORDS.clear()
+
+
+# ---------------------------------------------------------------------------
 # Static-analysis instrumentation (tony_tpu.analysis): the jaxpr analyzer
 # banks one record per analyzed step — finding counts by rule, waived
 # count, the step-signature digest (eqn/collective counts, live-buffer
@@ -262,12 +289,12 @@ _SAFE_RECORD_FAILED: set = set()
 def safe_record(kind: str, tag: str, /, **fields) -> None:
     """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
     ``"input"``/``"collective"``/``"update"``/``"quant"``/
-    ``"analysis"``), swallowing any failure."""
+    ``"serve"``/``"analysis"``), swallowing any failure."""
     try:
         {"overlap": record_overlap, "ckpt": record_ckpt,
          "input": record_input, "collective": record_collective,
          "update": record_update, "quant": record_quant,
-         "analysis": record_analysis}[kind](
+         "serve": record_serve, "analysis": record_analysis}[kind](
              tag, **fields)
     except Exception:  # noqa: BLE001
         if kind not in _SAFE_RECORD_FAILED:

@@ -290,12 +290,15 @@ class ApplicationRpcHandler:
         }
 
     def rpc_heartbeat(self, job_type: str, index: int,
-                      ckpt_step: Optional[int] = None) -> bool:
-        """Liveness + checkpoint progress: executors that see a
-        ``tony.ckpt.dir`` piggyback the last COMMITTED step on the
-        heartbeat so the AM knows what a gang restart resumes from
-        (optional param — seed-era executors send none)."""
-        self.session.on_heartbeat(job_type, index, ckpt_step=ckpt_step)
+                      ckpt_step: Optional[int] = None,
+                      serve: Optional[Dict[str, float]] = None) -> bool:
+        """Liveness + checkpoint progress + serving telemetry: executors
+        that see a ``tony.ckpt.dir`` piggyback the last COMMITTED step;
+        serve-replica executors piggyback the engine's published
+        qps/p99_ms/queue_depth (the autoscaler's signal). Both params
+        optional — seed-era executors send neither."""
+        self.session.on_heartbeat(job_type, index, ckpt_step=ckpt_step,
+                                  serve=serve)
         return True
 
     def rpc_register_execution_result(self, job_type: str, index: int,

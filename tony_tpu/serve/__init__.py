@@ -1,0 +1,66 @@
+"""Online serving plane: the framework's second workload class.
+
+Training gave TonY-TPU an owned compute/checkpoint/data plane; this
+package opens the inference loop the same way — owned end to end, per
+TF-Replicator's lesson (PAPERS 1902.00465: a framework that doesn't own
+the execution loop watches every user rebuild it badly):
+
+* :mod:`~tony_tpu.serve.kvcache` — the paged KV cache: a fixed-size
+  block pool with per-sequence block tables; admission failures are a
+  typed :class:`~tony_tpu.serve.kvcache.AdmissionError`, never an OOM;
+* :mod:`~tony_tpu.serve.engine` — the continuous-batching loop:
+  admission queue (the data plane's prefetcher pattern in reverse —
+  work queued ahead of the consumer instead of staged ahead of it),
+  bucketed static shapes so requests join and leave the running batch
+  at iteration granularity without recompilation, and a flash-decoding
+  attention step (:func:`tony_tpu.ops.flash_decode`) over the paged
+  cache;
+* :mod:`~tony_tpu.serve.replica` — one serving replica: sharded
+  training checkpoints load through elastic restore onto the replica's
+  own mesh (f32 master → bf16 serving via the restore-time dtype
+  policy), requests arrive over the control-plane RPC wire (fronted by
+  the existing TCP proxy), and qps/p99/queue-depth ride the executor
+  heartbeat so the AM can scale replicas against load;
+* :mod:`~tony_tpu.serve.scaling` — the pure (jax-free) replica-scaling
+  policy the AM's monitor loop applies.
+
+Numerics contract: continuous-batching decode is BIT-identical to a
+sequential full prefill of the same tokens — every op in the serve
+forward is row-independent and all row counts stay at sublane-tile
+multiples (the engine's ``q_block`` row blocks), so joining a batch or
+riding the paged cache cannot change a single bit of any request's
+logits. ``tests/test_serve.py`` pins this end to end.
+"""
+
+from typing import Any
+
+__all__ = ["AdmissionError", "Completion", "PagedKVCache", "Request",
+           "ServeEngine", "engine", "kvcache", "replica", "scaling"]
+
+# LAZY facade (PEP 562, like tony_tpu.analysis): the engine pulls jax,
+# but the AM's autoscaler only needs the pure scaling policy and the
+# executor's heartbeat reader needs nothing here at all — the control
+# plane must be able to import serve submodules without paying (or
+# breaking on) a jax import. name -> owning submodule (None = the name
+# IS a submodule).
+_LAZY = {
+    "AdmissionError": "kvcache", "PagedKVCache": "kvcache",
+    "Completion": "engine", "Request": "engine", "ServeEngine": "engine",
+    "engine": None, "kvcache": None, "replica": None, "scaling": None,
+}
+
+
+def __getattr__(name: str) -> Any:
+    import importlib
+
+    owner = _LAZY.get(name, "<missing>")
+    if owner == "<missing>":
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    if owner is None:
+        return importlib.import_module(f"{__name__}.{name}")
+    return getattr(importlib.import_module(f"{__name__}.{owner}"), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,485 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+One engine owns one replica's decode loop. The structure inverts the
+data plane's prefetcher (PR 4): there, a producer thread stages batches
+AHEAD of the training step; here, callers queue requests BEHIND the
+decode loop (the admission queue) and the loop pulls them into the
+running batch at iteration granularity — a request joins as soon as pool
+blocks and a batch slot are free, and leaves (eviction) the step its
+generation completes, with every other sequence's decode undisturbed.
+
+Static shapes are bucketed so join/evict never recompiles:
+
+* **row blocks** — every forward processes query rows in blocks of
+  ``q_block`` (default 16, the bf16 sublane tile): prefill pads the
+  prompt to a whole number of blocks, decode processes one block per
+  sequence (1 real new token + padding rows whose cache writes are
+  dropped). Fixed-tile row counts are ALSO the numerics contract: every
+  serve op is row-independent at tile-multiple shapes, which is what
+  makes continuous-batching decode bit-identical to a sequential full
+  prefill of the same tokens (the tests pin it; single-row GEMV paths
+  are where XLA CPU breaks row invariance, so the engine never issues
+  one);
+* **decode buckets** — the joined batch pads up to the next bucket size,
+  so the decode step compiles once per bucket, not per batch
+  composition;
+* **one context extent** — the KV buffer gathered per step is always
+  ``ctx_pad = nb_max · block_size`` positions, so ragged sequence
+  lengths never change a shape (masking by absolute position does the
+  rest).
+
+The decode step is registered with the collective planner at build time
+(:func:`tony_tpu.profiler.record_collective`, plane ``serve_decode``)
+with an EMPTY expected set: a replica's decode touches no inter-chip
+collective — its mesh exists for memory, not for cross-replica math —
+and ``tony analyze --config serve`` audits the traced step against that
+promise (a GSPMD-inserted reshard is a finding, not a slowdown).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu._trace import trace_record
+from tony_tpu.compat import mesh_context
+from tony_tpu.serve.kvcache import AdmissionError, PagedKVCache
+
+_record = functools.partial(trace_record, "serve")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``max_new_tokens`` is a hard cap; the
+    engine reserves pool blocks for ``len(tokens) + max_new_tokens`` at
+    admission so decode can never exhaust the pool mid-flight."""
+    rid: Any
+    tokens: List[int]
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class Completion:
+    """One finished request: the generated tokens, per-position f32
+    logits when the engine keeps them (``keep_logits=True`` — the test
+    pin surface), and the request's wall latency."""
+    rid: Any
+    prompt: List[int]
+    tokens: List[int]
+    logits: Optional[List[np.ndarray]]
+    latency_s: float
+
+
+class _Seq:
+    __slots__ = ("rid", "tokens", "n_prompt", "remaining", "logits",
+                 "t_submit")
+
+    def __init__(self, req: Request, t_submit: float):
+        self.rid = req.rid
+        self.tokens: List[int] = list(req.tokens)
+        self.n_prompt = len(req.tokens)
+        self.remaining = int(req.max_new_tokens)
+        self.logits: List[np.ndarray] = []
+        self.t_submit = t_submit
+
+
+def _bucket_of(buckets: Sequence[int], n: int) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch {n} exceeds the largest decode bucket "
+                     f"{max(buckets)}")
+
+
+class ServeEngine:
+    """Continuous-batching loop for one replica.
+
+    ``model`` is a serve-capable flax module (today:
+    :class:`tony_tpu.models.transformer.Transformer` — its ``kv=``
+    forward); ``params`` its (restored, typically bf16) param tree.
+    ``mesh`` wraps every jitted call in the replica's mesh context so
+    sharded params compute in place; ``None`` runs on the default
+    device placement.
+    """
+
+    def __init__(self, model: Any, params: Any, *, ctx_max: int,
+                 block_size: int = 16, n_blocks: Optional[int] = None,
+                 q_block: int = 16, decode_buckets: Sequence[int] = (4, 16),
+                 max_running: int = 16, mesh: Optional[Any] = None,
+                 keep_logits: bool = False, join_policy: str = "continuous",
+                 stats_window_s: float = 60.0, tag: str = "serve"):
+        cfg = model.cfg
+        if q_block % 8:
+            raise ValueError(f"q_block must be a sublane-tile multiple "
+                             f"(8), got {q_block}")
+        if join_policy not in ("continuous", "static"):
+            raise ValueError(f"unknown join_policy {join_policy!r} "
+                             "(continuous|static)")
+        self.model = model
+        self.params = params
+        self.mesh = mesh
+        self.q_block = int(q_block)
+        self.keep_logits = keep_logits
+        self.join_policy = join_policy
+        self.tag = tag
+        self.decode_buckets = tuple(sorted(set(
+            list(decode_buckets) + [max_running])))
+        self.max_running = int(max_running)
+        self.n_layers = cfg.n_layers
+        self.kv_dim = cfg.n_kv_heads * cfg.head_dim
+        self.block_size = int(block_size)
+        nb_max = -(-int(ctx_max) // self.block_size)
+        self.nb_max = nb_max
+        self.ctx_pad = nb_max * self.block_size
+        if n_blocks is None:
+            n_blocks = nb_max * self.max_running
+        self.cache = PagedKVCache(self.n_layers, self.kv_dim,
+                                  n_blocks=n_blocks,
+                                  block_size=self.block_size,
+                                  dtype=cfg.dtype)
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._running: List[_Seq] = []
+        self._fns: Dict[Tuple[int, int], Callable] = {}
+        # Telemetry: completion ring for p50/p99, monotonic counters for
+        # rates — O(1) per step, million-request safe.
+        # (t_done, latency_s, n_tokens) per completion: rates and
+        # percentiles are computed over a TIME window, not lifetime —
+        # the autoscaler reads p99/qps as "now", and a latency spike
+        # from an hour-old burst must age out or scale-down never fires.
+        self._events: deque = deque(maxlen=512)
+        self.stats_window_s = float(stats_window_s)
+        self._completed = 0
+        self._tokens_out = 0
+        self._t0 = time.monotonic()
+        self._steps = 0
+        # Forward-launch counter (prefills + decode steps): the
+        # machine-independent cost of a schedule — on an accelerator the
+        # forward dominates wall time, so fewer launches for the same
+        # tokens IS the continuous-batching win.
+        self.forwards = 0
+        self.register_plan()
+
+    # -- planner/profiler registration ------------------------------------
+    def register_plan(self) -> None:
+        """Register the decode step's (empty) collective schedule with
+        the unified planner record plus the engine geometry — the
+        day-one registration ROADMAP asks of every new step-path plane;
+        ``tony analyze --config serve`` audits the traced decode against
+        exactly this promise."""
+        trace_record("collective", "serve_decode", kind="none",
+                     plane="serve_decode", axes=[], nbytes=[],
+                     note="replica-local decode: zero inter-chip "
+                          "collectives")
+        _record(self.tag, ctx_pad=self.ctx_pad,
+                block_size=self.block_size, nb_max=self.nb_max,
+                n_blocks=self.cache.n_blocks, q_block=self.q_block,
+                decode_buckets=list(self.decode_buckets),
+                max_running=self.max_running,
+                join_policy=self.join_policy)
+
+    def expected_collectives(self) -> list:
+        """The planner-registered expected collective set of the decode
+        step: empty — a replica mesh shards memory, never the decode
+        math. The analyzer reconciles the traced program against this."""
+        return []
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """Queue a request (thread-safe). Requests that can NEVER fit
+        the context buffer are rejected now with a non-retryable
+        :class:`AdmissionError`; pool pressure is handled later, at
+        join time, by leaving the request queued."""
+        total = len(req.tokens) + req.max_new_tokens
+        if not req.tokens:
+            raise ValueError(f"request {req.rid!r}: empty prompt")
+        needed = self.cache.blocks_for(total)
+        if total > self.ctx_pad or needed > self.cache.n_blocks:
+            # Over the context extent OR over the ENTIRE pool (an
+            # explicit small n_blocks): queueing it as retryable would
+            # livelock the loop — join would re-raise forever with
+            # nothing ever freeing enough.
+            raise AdmissionError(
+                f"request {req.rid!r} needs {total} positions "
+                f"({needed} blocks) > engine capacity (context "
+                f"{self.ctx_pad}, pool {self.cache.n_blocks} blocks); "
+                f"it can never be admitted",
+                needed_blocks=needed,
+                free_blocks=self.cache.free_blocks, retryable=False)
+        with self._lock:
+            self._queue.append((req, time.monotonic()))
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def running(self) -> int:
+        return len(self._running)
+
+    # -- jitted forward family --------------------------------------------
+    def _fn(self, b: int, t: int) -> Callable:
+        """The (b, t)-shaped jitted step: gather each sequence's blocks
+        into the fixed-extent KV buffers, run the serve forward, commit
+        the fresh rows back to the pool through the host-computed flat
+        scatter indices (OOB rows drop). Pools are donated — the engine
+        immediately rebinds them, so the update is in-place-ish."""
+        key = (b, t)
+        if key in self._fns:
+            return self._fns[key]
+        L, nb, bs, kvd = (self.n_layers, self.cache.n_blocks,
+                          self.block_size, self.kv_dim)
+        ctx = self.ctx_pad
+        model = self.model
+
+        def fn(params, pool_k, pool_v, tokens, positions, tables,
+               flat_idx):
+            # mode="clip", NOT the default NaN-fill: table padding (and
+            # the scratch reference's contiguous table on a small pool)
+            # may point past the pool, and those positions are masked by
+            # the attention — but only 0 x FINITE is exactly 0; a
+            # NaN-filled block would poison every masked row.
+            kbuf = jnp.take(pool_k, tables, axis=1,
+                            mode="clip").reshape(L, b, ctx, kvd)
+            vbuf = jnp.take(pool_v, tables, axis=1,
+                            mode="clip").reshape(L, b, ctx, kvd)
+            logits, (knew, vnew) = model.apply(
+                {"params": params}, tokens, positions=positions,
+                kv=(kbuf, vbuf))
+            pk = pool_k.reshape(L, nb * bs, kvd).at[:, flat_idx].set(
+                knew.astype(pool_k.dtype), mode="drop")
+            pv = pool_v.reshape(L, nb * bs, kvd).at[:, flat_idx].set(
+                vnew.astype(pool_v.dtype), mode="drop")
+            return (logits, pk.reshape(L, nb, bs, kvd),
+                    pv.reshape(L, nb, bs, kvd))
+
+        jitted = jax.jit(fn, donate_argnums=(1, 2))
+        self._fns[key] = jitted
+        return jitted
+
+    def _run_fn(self, b, t, tokens, positions, tables, flat_idx):
+        fn = self._fn(b, t)
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(flat_idx))
+        if self.mesh is not None:
+            with mesh_context(self.mesh):
+                logits, pk, pv = fn(*args)
+        else:
+            logits, pk, pv = fn(*args)
+        self.cache.k, self.cache.v = pk, pv
+        self.forwards += 1
+        return logits
+
+    # -- prefill -----------------------------------------------------------
+    def _prefill(self, seq: _Seq) -> None:
+        t_real = len(seq.tokens)
+        t_pad = -(-t_real // self.q_block) * self.q_block
+        tokens = np.zeros((1, t_pad), np.int32)
+        tokens[0, :t_real] = seq.tokens
+        positions = np.broadcast_to(
+            np.arange(t_pad, dtype=np.int32)[None], (1, t_pad)).copy()
+        tables = self.cache.table_array([seq.rid], self.nb_max)
+        flat = np.full((1, t_pad), self.cache.oob_index, np.int32)
+        for p in range(t_real):
+            flat[0, p] = self.cache.flat_index(seq.rid, p)
+        logits = self._run_fn(1, t_pad, tokens, positions, tables, flat)
+        last = np.asarray(logits[0, t_real - 1], np.float32)
+        self._emit_token(seq, last)
+
+    # -- decode ------------------------------------------------------------
+    def _decode(self) -> None:
+        seqs = list(self._running)
+        b = _bucket_of(self.decode_buckets, len(seqs))
+        t = self.q_block
+        tokens = np.zeros((b, t), np.int32)
+        positions = np.zeros((b, t), np.int32)
+        tables = np.zeros((b, self.nb_max), np.int32)
+        flat = np.full((b, t), self.cache.oob_index, np.int32)
+        tables[:len(seqs)] = self.cache.table_array(
+            [s.rid for s in seqs], self.nb_max)
+        for i, s in enumerate(seqs):
+            p0 = len(s.tokens) - 1          # the newest, not-yet-fed token
+            tokens[i, 0] = s.tokens[-1]
+            positions[i] = p0 + np.arange(t, dtype=np.int32)
+            flat[i, 0] = self.cache.flat_index(s.rid, p0)
+        logits = self._run_fn(b, t, tokens, positions, tables, flat)
+        rows = np.asarray(logits[:len(seqs), 0], np.float32)
+        for i, s in enumerate(seqs):
+            self._emit_token(s, rows[i])
+
+    def _emit_token(self, seq: _Seq, row: np.ndarray) -> None:
+        if self.keep_logits:
+            seq.logits.append(row.copy())
+        seq.tokens.append(int(np.argmax(row)))   # greedy: deterministic
+        seq.remaining -= 1
+
+    # -- scheduling --------------------------------------------------------
+    def _join(self, results: List[Completion]) -> None:
+        if self.join_policy == "static" and self._running:
+            return
+        while len(self._running) < self.max_running:
+            with self._lock:
+                if not self._queue:
+                    return
+                req, t_submit = self._queue[0]
+            try:
+                self.cache.reserve(req.rid,
+                                   len(req.tokens) + req.max_new_tokens)
+            except AdmissionError:
+                return                      # pool pressure: stay queued
+            with self._lock:
+                self._queue.popleft()
+            seq = _Seq(req, t_submit)
+            self._prefill(seq)
+            if seq.remaining <= 0:          # max_new_tokens == 1
+                self._evict(seq, results)
+            else:
+                self._running.append(seq)
+
+    def _evict(self, seq: _Seq, results: List[Completion]) -> None:
+        self.cache.free_seq(seq.rid)
+        now = time.monotonic()
+        self._events.append((now, now - seq.t_submit,
+                             len(seq.tokens) - seq.n_prompt))
+        self._completed += 1
+        self._tokens_out += len(seq.tokens) - seq.n_prompt
+        results.append(Completion(
+            rid=seq.rid, prompt=seq.tokens[:seq.n_prompt],
+            tokens=seq.tokens[seq.n_prompt:],
+            logits=seq.logits if self.keep_logits else None,
+            latency_s=now - seq.t_submit))
+
+    def step(self) -> List[Completion]:
+        """One engine iteration: join what fits, decode one token for
+        every running sequence, evict what finished. Returns the
+        completions this step produced."""
+        results: List[Completion] = []
+        self._join(results)
+        if self._running:
+            self._decode()
+            still = []
+            for s in self._running:
+                if s.remaining <= 0:
+                    self._evict(s, results)
+                else:
+                    still.append(s)
+            self._running = still
+        self._steps += 1
+        return results
+
+    def run(self, max_steps: Optional[int] = None) -> List[Completion]:
+        """Drive :meth:`step` until queue and batch drain (or
+        ``max_steps``)."""
+        out: List[Completion] = []
+        while (self.queue_depth or self._running) and \
+                (max_steps is None or self._steps < max_steps):
+            out.extend(self.step())
+        return out
+
+    # -- the sequential reference -----------------------------------------
+    def full_prefill_logits(self, tokens: Sequence[int]) -> np.ndarray:
+        """Sequential full-prefill reference: process ``tokens`` as ONE
+        isolated prefill on a scratch pool (same jitted shape family,
+        same ops) and return the real rows' f32 logits ``[len, vocab]``.
+        The continuous-batching pin compares each request's streamed
+        decode logits against rows of THIS, bit for bit."""
+        t_real = len(tokens)
+        if t_real > self.ctx_pad:
+            raise ValueError(f"{t_real} tokens > engine context "
+                             f"{self.ctx_pad}")
+        t_pad = -(-t_real // self.q_block) * self.q_block
+        toks = np.zeros((1, t_pad), np.int32)
+        toks[0, :t_real] = list(tokens)
+        positions = np.broadcast_to(
+            np.arange(t_pad, dtype=np.int32)[None], (1, t_pad)).copy()
+        # Contiguous scratch table on a zero pool of the SAME geometry,
+        # so the jit cache is shared with live prefills (clipped: the
+        # pool may hold fewer blocks than the context extent, and the
+        # tail positions are masked anyway).
+        tables = np.minimum(np.arange(self.nb_max, dtype=np.int32),
+                            self.cache.n_blocks - 1)[None].copy()
+        flat = np.full((1, t_pad), self.cache.oob_index, np.int32)
+        bs = self.block_size
+        for p in range(t_real):
+            flat[0, p] = (p // bs) * bs + (p % bs)
+        fn = self._fn(1, t_pad)
+        scratch_k = jnp.zeros_like(self.cache.k)
+        scratch_v = jnp.zeros_like(self.cache.v)
+        args = (self.params, scratch_k, scratch_v, jnp.asarray(toks),
+                jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(flat))
+        if self.mesh is not None:
+            with mesh_context(self.mesh):
+                logits, _, _ = fn(*args)
+        else:
+            logits, _, _ = fn(*args)
+        return np.asarray(logits[0, :t_real], np.float32)
+
+    # -- telemetry ---------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        """The serve heartbeat triple (+ rates): qps, p50/p99 request
+        latency, queue depth. Rates and percentiles cover the last
+        ``stats_window_s`` only (bounded by engine age), so an idle
+        replica's p99 decays to 0 and the autoscaler's scale-down gate
+        can actually fire; ``completed``/``steps``/``forwards`` stay
+        lifetime counters."""
+        now = time.monotonic()
+        recent = [(l, n) for t, l, n in self._events
+                  if now - t <= self.stats_window_s]
+        lat = sorted(l for l, _ in recent)
+        dt = max(1e-9, min(self.stats_window_s, now - self._t0))
+
+        def pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * (len(lat) - 1) + 0.5))]
+
+        stats = {
+            "qps": len(recent) / dt,
+            "tokens_per_s": sum(n for _, n in recent) / dt,
+            "p50_ms": 1e3 * pct(0.50),
+            "p99_ms": 1e3 * pct(0.99),
+            "queue_depth": float(self.queue_depth),
+            "running": float(len(self._running)),
+            "completed": float(self._completed),
+            "steps": float(self._steps),
+            "forwards": float(self.forwards),
+        }
+        _record(f"{self.tag}_stats", **stats)
+        return stats
+
+    def write_stats(self, path: str) -> None:
+        """Atomically publish :meth:`stats` as JSON — the file the
+        executor's heartbeat loop piggybacks to the AM (jax-free on the
+        reader side)."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(self.stats(), fh)
+        os.replace(tmp, path)
+
+    # -- static-analysis hook ---------------------------------------------
+    def decode_traced(self, batch: Optional[int] = None):
+        """``(jitted, example_args)`` of the canonical decode bucket for
+        :func:`tony_tpu.analysis.analyze_serve_step` — the same jit the
+        loop runs, traced, never executed."""
+        b = _bucket_of(self.decode_buckets,
+                       batch if batch is not None else 1)
+        t = self.q_block
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((b, t), jnp.int32),
+                jnp.zeros((b, t), jnp.int32),
+                jnp.zeros((b, self.nb_max), jnp.int32),
+                jnp.full((b, t), self.cache.oob_index, jnp.int32))
+        return self._fn(b, t), args

@@ -1,0 +1,138 @@
+"""Paged KV cache: a fixed-size block pool with per-sequence block tables.
+
+The pool is two device arrays ``[n_layers, n_blocks, block_size, kv_dim]``
+(k and v); a sequence owns an ordered list of block ids (its *block
+table*) covering positions ``[0, len)`` — position ``p`` lives at row
+``p % block_size`` of block ``table[p // block_size]``. Allocation is
+host-side bookkeeping only (a free list of ids); the device arrays are
+written by the engine's jitted step through flat scatter indices the
+allocator hands out. Blocks are NOT zeroed on free/realloc: every
+position is written before any query can attend it (the flash-decode
+mask admits key ``j`` only for rows at position ``>= j``), so stale
+bytes are provably unread — and the reuse test pins that.
+
+Capacity failures are a typed :class:`AdmissionError` carrying the
+needed/free block counts — an admission-control signal the engine (or a
+load balancer above it) can act on, categorically different from an
+allocator OOM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class AdmissionError(RuntimeError):
+    """The request cannot enter the engine NOW: the block pool cannot
+    host it (or it can never fit). Retry/queue/shed upstream — this is
+    back-pressure, not a crash."""
+
+    def __init__(self, message: str, *, needed_blocks: int = 0,
+                 free_blocks: int = 0, retryable: bool = True):
+        super().__init__(message)
+        self.needed_blocks = needed_blocks
+        self.free_blocks = free_blocks
+        # False: the request exceeds engine capacity outright (longer
+        # than the context buffer) and will never fit, even on an idle
+        # engine.
+        self.retryable = retryable
+
+
+class PagedKVCache:
+    """Host-managed block allocator over device-resident KV block pools."""
+
+    def __init__(self, n_layers: int, kv_dim: int, *, n_blocks: int,
+                 block_size: int, dtype: Any = jnp.bfloat16):
+        if n_blocks <= 0 or block_size <= 0:
+            raise ValueError(f"need positive n_blocks/block_size, got "
+                             f"{n_blocks}/{block_size}")
+        self.n_layers = int(n_layers)
+        self.kv_dim = int(kv_dim)
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.k = jnp.zeros((n_layers, n_blocks, block_size, kv_dim), dtype)
+        self.v = jnp.zeros((n_layers, n_blocks, block_size, kv_dim), dtype)
+        # LIFO free list: a just-freed block is the next handed out, so
+        # the reuse invariants get exercised constantly, not just under
+        # pressure.
+        self._free: List[int] = list(range(n_blocks - 1, -1, -1))
+        self._tables: Dict[Any, List[int]] = {}
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, length: int) -> int:
+        """Blocks covering ``length`` positions."""
+        return -(-max(0, int(length)) // self.block_size)
+
+    # -- allocation --------------------------------------------------------
+    def reserve(self, seq_id: Any, length: int) -> List[int]:
+        """Grow ``seq_id``'s table to cover ``length`` positions,
+        allocating from the free list; raises :class:`AdmissionError`
+        (state unchanged) when the pool can't supply the growth. The
+        engine reserves a request's FULL extent (prompt + max new
+        tokens) at admission, so decode can never hit pool exhaustion
+        mid-flight."""
+        table = self._tables.setdefault(seq_id, [])
+        needed = self.blocks_for(length) - len(table)
+        if needed > len(self._free):
+            raise AdmissionError(
+                f"KV pool exhausted: sequence {seq_id!r} needs {needed} "
+                f"more block(s) for {length} positions, {len(self._free)} "
+                f"free of {self.n_blocks}",
+                needed_blocks=needed, free_blocks=len(self._free))
+        for _ in range(max(0, needed)):
+            table.append(self._free.pop())
+        return list(table)
+
+    def free_seq(self, seq_id: Any) -> int:
+        """Return all of ``seq_id``'s blocks to the pool; returns the
+        count (0 for an unknown id — idempotent eviction)."""
+        table = self._tables.pop(seq_id, [])
+        self._free.extend(reversed(table))
+        return len(table)
+
+    def table(self, seq_id: Any) -> List[int]:
+        return list(self._tables.get(seq_id, []))
+
+    def owned_blocks(self) -> Dict[Any, List[int]]:
+        """Live ownership snapshot (test surface for the alloc/free/reuse
+        invariants: disjoint tables, free+owned partitions the pool)."""
+        return {sid: list(t) for sid, t in self._tables.items()}
+
+    # -- device-side addressing --------------------------------------------
+    def table_array(self, seq_ids: Sequence[Any], nb_max: int) -> np.ndarray:
+        """Padded int32 ``[len(seq_ids), nb_max]`` block tables for the
+        jitted step's gather (pad entries point at block 0 — gathered
+        bytes there are masked by position before any row reads them)."""
+        out = np.zeros((len(seq_ids), nb_max), np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = self._tables.get(sid, [])
+            if len(t) > nb_max:
+                raise ValueError(
+                    f"sequence {sid!r} holds {len(t)} blocks > nb_max="
+                    f"{nb_max}")
+            out[i, :len(t)] = t
+        return out
+
+    def flat_index(self, seq_id: Any, pos: int) -> int:
+        """Flat scatter index of position ``pos`` into the
+        ``[n_blocks·block_size]``-flattened pool."""
+        table = self._tables[seq_id]
+        b, r = divmod(int(pos), self.block_size)
+        if b >= len(table):
+            raise IndexError(
+                f"position {pos} beyond sequence {seq_id!r}'s "
+                f"{len(table)}-block reservation")
+        return table[b] * self.block_size + r
+
+    @property
+    def oob_index(self) -> int:
+        """One-past-the-pool flat index: scatters routed here with
+        ``mode='drop'`` write nothing (padding rows, dummy batch slots)."""
+        return self.n_blocks * self.block_size

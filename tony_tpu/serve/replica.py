@@ -1,0 +1,210 @@
+"""One serving replica: elastic-restored params + engine + RPC front.
+
+A replica is the serve job type's user process (``python -m
+tony_tpu.serve.replica``, launched by the executor like any other
+workload). Startup:
+
+1. build the registered model (``tony.serve.model`` + JSON kwargs —
+   including ``quant=`` lanes, which serve through the same projections
+   training used);
+2. restore ONLY the params subtree of the training checkpoint through
+   elastic restore onto the replica's own mesh
+   (:func:`tony_tpu.ckpt.find_path_prefix` locates the subtree whatever
+   the save's wrapping; ``dtype_policy="bf16"`` casts the f32 master to
+   the serving dtype during shard assembly — optimizer slots are never
+   even read);
+3. run a :class:`~tony_tpu.serve.engine.ServeEngine` behind the
+   control-plane RPC wire (same JSON-lines protocol as the AM — and the
+   existing :class:`tony_tpu.proxy.ProxyServer` fronts it for gateway
+   access, exactly like notebooks);
+4. publish the engine's qps/p99/queue-depth to the ``TONY_SERVE_STATS``
+   file the executor's heartbeat piggybacks to the AM — the signal the
+   replica autoscaler acts on.
+
+Concurrent ``generate`` RPCs drive ONE shared engine: each call submits
+its request and then takes turns advancing the loop until its own
+completion lands, so overlapping calls naturally join the continuous
+batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
+                           SERVE_CTX_MAX, SERVE_DTYPE_POLICY,
+                           SERVE_MAX_RUNNING, SERVE_MESH, SERVE_MODEL,
+                           SERVE_MODEL_KWARGS, SERVE_PORT)
+from tony_tpu.serve.engine import Completion, Request, ServeEngine
+
+
+class Replica:
+    """Build (restore + engine) and front one serving replica."""
+
+    def __init__(self, *, model_name: str,
+                 model_kwargs: Optional[Dict[str, Any]] = None,
+                 ckpt_dir: str, dtype_policy: Optional[str] = "bf16",
+                 mesh: Optional[Any] = None, ctx_max: int = 2048,
+                 block_size: int = 16, q_block: int = 16,
+                 n_blocks: Optional[int] = None, max_running: int = 16,
+                 keep_logits: bool = False, tag: str = "serve"):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from tony_tpu import ckpt
+        from tony_tpu._trace import trace_record
+        from tony_tpu.compat import mesh_context
+        from tony_tpu.models import get_model
+
+        self.model = get_model(model_name, **(model_kwargs or {}))
+        self.mesh = mesh
+        sample = jnp.zeros((1, q_block), jnp.int32)
+
+        def init():
+            return nn.unbox(self.model.init(jax.random.PRNGKey(0),
+                                            sample))["params"]
+
+        # Template init: structure/shapes only — every value is replaced
+        # by the restore below (and the restore is what the e2e test
+        # pins, so a template that accidentally survived would fail it).
+        if mesh is not None:
+            with mesh_context(mesh):
+                template = jax.jit(init)()
+        else:
+            template = init()
+        step = ckpt.latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {ckpt_dir} — a replica "
+                f"serves a trained model, it does not initialize one")
+        prefix = ckpt.find_path_prefix(ckpt_dir, template, step=step)
+        params = ckpt.restore_pytree(
+            ckpt_dir, template, step=step, mesh=mesh,
+            dtype_policy=dtype_policy, path_prefix=prefix)
+        self.restored_step = step
+        self.engine = ServeEngine(
+            self.model, params, ctx_max=ctx_max, block_size=block_size,
+            q_block=q_block, n_blocks=n_blocks, max_running=max_running,
+            mesh=mesh, keep_logits=keep_logits, tag=tag)
+        trace_record("serve", "replica", model=model_name,
+                     ckpt_step=step, path_prefix=prefix,
+                     dtype_policy=dtype_policy,
+                     mesh_axes=dict(getattr(mesh, "shape", {}) or {}))
+        self._drive = threading.Lock()
+        self._done: Dict[Any, Completion] = {}
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+
+    # -- request path ------------------------------------------------------
+    def generate(self, tokens: Sequence[int], max_new_tokens: int,
+                 rid: Optional[Any] = None) -> Completion:
+        """Submit one request and drive the shared engine until it
+        completes. Thread-safe: concurrent callers interleave on the
+        drive lock, so their requests ride one continuous batch."""
+        if rid is None:
+            with self._rid_lock:
+                self._rid += 1
+                rid = f"req-{self._rid}"
+        self.engine.submit(Request(rid=rid, tokens=list(tokens),
+                                   max_new_tokens=int(max_new_tokens)))
+        while True:
+            with self._drive:
+                if rid in self._done:
+                    return self._done.pop(rid)
+                for c in self.engine.step():
+                    self._done[c.rid] = c
+            # Another thread may own the completion we need next round;
+            # yield so it can collect.
+            time.sleep(0)
+
+    # -- RPC front ---------------------------------------------------------
+    def rpc_handler(self) -> "_ReplicaRpcHandler":
+        return _ReplicaRpcHandler(self)
+
+    def serve_forever(self, *, host: str = "0.0.0.0", port: int = 0,
+                      stats_path: Optional[str] = None,
+                      stats_every_s: float = 2.0,
+                      stop: Optional[threading.Event] = None) -> None:
+        """Run the RPC server and the stats publisher until ``stop``."""
+        from tony_tpu.rpc import RpcServer
+
+        server = RpcServer(self.rpc_handler(), host=host, port=port)
+        server.start()
+        self.port = server.port
+        print(f"[tony-serve-replica] listening on {server.address} "
+              f"(ckpt step {self.restored_step})", flush=True)
+        stop = stop or threading.Event()
+        try:
+            while not stop.wait(stats_every_s):
+                if stats_path:
+                    try:
+                        self.engine.write_stats(stats_path)
+                    except OSError:
+                        pass
+        finally:
+            server.stop()
+
+
+class _ReplicaRpcHandler:
+    """RPC verbs of one replica (JSON-lines wire, same as the AM's)."""
+
+    def __init__(self, replica: Replica):
+        self.replica = replica
+
+    def rpc_generate(self, tokens: List[int], max_new_tokens: int = 16,
+                     rid: Optional[str] = None) -> Dict[str, Any]:
+        c = self.replica.generate(tokens, max_new_tokens, rid=rid)
+        return {"rid": c.rid, "tokens": c.tokens,
+                "latency_ms": round(1e3 * c.latency_s, 3)}
+
+    def rpc_serve_stats(self) -> Dict[str, float]:
+        return self.replica.engine.stats()
+
+
+def main() -> int:
+    """``python -m tony_tpu.serve.replica`` — the serve job type's user
+    command. Config comes from the job conf (``TONY_CONF_PATH``, written
+    by ``tony serve``); the stats file path from ``TONY_SERVE_STATS``
+    (exported by the executor)."""
+    from tony_tpu import constants
+    from tony_tpu.conf import TonyConfig
+
+    conf_path = os.environ.get(constants.ENV_CONF_PATH)
+    if not conf_path:
+        print("[tony-serve-replica] no TONY_CONF_PATH; run under a tony "
+              "serve job")
+        return 1
+    conf = TonyConfig.load(conf_path)
+    model_name = conf.get(SERVE_MODEL)
+    ckpt_dir = conf.get(SERVE_CKPT_DIR) or conf.get(CKPT_DIR)
+    if not model_name or not ckpt_dir:
+        print(f"[tony-serve-replica] need {SERVE_MODEL} and "
+              f"{SERVE_CKPT_DIR} in the job conf")
+        return 1
+    mesh = None
+    mesh_kw = conf.get(SERVE_MESH)
+    if mesh_kw:
+        from tony_tpu import parallel as par
+        mesh = par.MeshSpec(**json.loads(mesh_kw)).build()
+    replica = Replica(
+        model_name=model_name,
+        model_kwargs=json.loads(conf.get(SERVE_MODEL_KWARGS) or "{}"),
+        ckpt_dir=ckpt_dir,
+        dtype_policy=conf.get(SERVE_DTYPE_POLICY, "bf16"),
+        mesh=mesh,
+        ctx_max=conf.get_int(SERVE_CTX_MAX, 2048),
+        block_size=conf.get_int(SERVE_BLOCK_SIZE, 16),
+        max_running=conf.get_int(SERVE_MAX_RUNNING, 16))
+    replica.serve_forever(
+        port=conf.get_int(SERVE_PORT, 0),
+        stats_path=os.environ.get(constants.ENV_SERVE_STATS))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

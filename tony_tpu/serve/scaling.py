@@ -1,0 +1,103 @@
+"""Replica-scaling policy: the pure half of heartbeat-driven autoscale.
+
+Arax's framing (PAPERS 2305.01291) — jobs declare resources, the runtime
+remaps them against load — lands here as a deliberately boring control
+loop: serve replicas report ``qps``/``p99_ms``/``queue_depth`` over the
+executor heartbeat, the AM's monitor loop feeds the latest sample per
+RUNNING replica into :func:`decide`, and applies the returned delta (one
+replica per decision, with a cooldown, so the loop can't flap). This
+module is jax-free and side-effect-free on purpose: the decision is unit
+testable without an AM, and the AM glue (``_autoscale_serve``) stays a
+dumb applier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+from tony_tpu.conf import (SERVE_COOLDOWN_S, SERVE_P99_HIGH_MS,
+                           SERVE_QUEUE_HIGH, SERVE_QUEUE_LOW,
+                           SERVE_REPLICAS_MAX, SERVE_REPLICAS_MIN)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalingPolicy:
+    """Thresholds for one serve job type. ``queue_high``/``queue_low``
+    are per-replica mean queue depths; ``p99_high_ms`` (0 = disabled)
+    scales up on tail latency even when queues look shallow."""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    queue_high: float = 8.0
+    queue_low: float = 1.0
+    p99_high_ms: float = 0.0
+    cooldown_s: float = 30.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got "
+                             f"{self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}")
+        if self.queue_low > self.queue_high:
+            raise ValueError(
+                f"queue_low {self.queue_low} > queue_high "
+                f"{self.queue_high} would oscillate")
+
+    @classmethod
+    def from_conf(cls, conf, instances: int) -> "ScalingPolicy":
+        """Policy from job config; ``instances`` (the jobtype's static
+        count) is the floor and the default ceiling — autoscale is OFF
+        unless the conf raises ``tony.serve.replicas.max`` above it."""
+        return cls(
+            min_replicas=conf.get_int(SERVE_REPLICAS_MIN, instances),
+            max_replicas=max(conf.get_int(SERVE_REPLICAS_MAX, instances),
+                             conf.get_int(SERVE_REPLICAS_MIN, instances)),
+            queue_high=conf.get_float(SERVE_QUEUE_HIGH, 8.0),
+            queue_low=conf.get_float(SERVE_QUEUE_LOW, 1.0),
+            p99_high_ms=conf.get_float(SERVE_P99_HIGH_MS, 0.0),
+            cooldown_s=conf.get_float(SERVE_COOLDOWN_S, 30.0),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_replicas > self.min_replicas
+
+
+def decide(policy: ScalingPolicy, n_running: int,
+           samples: Sequence[Dict[str, float]], *, now: float,
+           last_action: Optional[float] = None) -> int:
+    """The scaling delta (+1 / 0 / -1) for one serve job type.
+
+    ``samples`` is the latest heartbeat metric dict per RUNNING replica
+    (``qps``/``p99_ms``/``queue_depth``; replicas that haven't reported
+    yet contribute nothing). Rules, in order:
+
+    * below the floor (replica lost / startup): grow toward
+      ``min_replicas`` immediately — no cooldown, this is repair;
+    * inside the cooldown window after any action: hold;
+    * mean queue depth above ``queue_high`` — or p99 above
+      ``p99_high_ms`` when enabled — and below the ceiling: +1;
+    * mean queue depth below ``queue_low``, p99 comfortably under the
+      high-water, and above the floor: −1.
+    """
+    if n_running < policy.min_replicas:
+        return policy.min_replicas - n_running
+    if last_action is not None and now - last_action < policy.cooldown_s:
+        return 0
+    if not samples:
+        return 0
+    qd = sum(float(s.get("queue_depth", 0.0)) for s in samples) \
+        / len(samples)
+    p99 = max(float(s.get("p99_ms", 0.0)) for s in samples)
+    hot = qd > policy.queue_high or (
+        policy.p99_high_ms > 0 and p99 > policy.p99_high_ms)
+    if hot and n_running < policy.max_replicas:
+        return 1
+    cold = qd < policy.queue_low and (
+        policy.p99_high_ms <= 0 or p99 < 0.5 * policy.p99_high_ms)
+    if cold and n_running > policy.min_replicas:
+        return -1
+    return 0

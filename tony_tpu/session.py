@@ -54,10 +54,17 @@ class JobStatus(enum.Enum):
 class TonyTask:
     """One (job_type, index) task and its container/executor state."""
 
-    def __init__(self, job_type: str, index: int, tracked: bool):
+    def __init__(self, job_type: str, index: int, tracked: bool,
+                 elastic: bool = False):
         self.job_type = job_type
         self.index = index
         self.tracked = tracked
+        # Elastic tasks are added AFTER the session was built (the serve
+        # plane's replica scale-up): they never gate the gang barrier —
+        # the original gang's cluster spec is already sealed — and they
+        # are the only scale-DOWN victims, so the conf-declared floor
+        # stays intact.
+        self.elastic = elastic
         self.status = TaskStatus.NEW
         self.host: Optional[str] = None
         self.port: Optional[int] = None          # rendezvous port registered by executor
@@ -71,6 +78,10 @@ class TonyTask:
         # Last checkpoint step this task reported committed (heartbeat
         # piggyback; None until a tony.ckpt.dir executor reports one).
         self.ckpt_step: Optional[int] = None
+        # Latest serving telemetry this task piggybacked on its
+        # heartbeat (qps / p99_ms / queue_depth — tony_tpu.serve): what
+        # the AM's replica autoscaler decides on.
+        self.serve_metrics: Dict[str, float] = {}
         self.metrics: Dict[str, float] = {}
         # Timeline of TaskMonitor samples (reference: the per-task metric
         # history MetricsRpc accumulates for the portal). Bounded: at the
@@ -115,6 +126,8 @@ class TonyTask:
             "exit_code": self.exit_code,
             "diagnostics": self.diagnostics,
             "ckpt_step": self.ckpt_step,
+            "elastic": self.elastic,
+            "serve_metrics": dict(self.serve_metrics),
             "metrics": dict(self.metrics),
             "metrics_samples": len(self.metrics_history),
         }
@@ -181,9 +194,13 @@ class TonySession:
     # -- cluster spec (gang barrier) ---------------------------------------
     def all_registered(self) -> bool:
         """True once every task has called registerWorkerSpec — the gang
-        barrier after which executors may start user processes."""
+        barrier after which executors may start user processes. Elastic
+        tasks (added after the session was built) never gate it: the
+        original gang's spec is sealed, and a scale-up replica must not
+        re-open the barrier for anyone."""
         with self.lock:
-            return all(t.spec is not None for t in self._tasks.values())
+            return all(t.spec is not None for t in self._tasks.values()
+                       if not t.elastic)
 
     def cluster_spec(self) -> Dict[str, List[str]]:
         """``{job_type: ["host:port", ...]}`` ordered by task index
@@ -240,11 +257,54 @@ class TonySession:
                     t.start_time = t.start_time or now
 
     def on_heartbeat(self, job_type: str, index: int,
-                     ckpt_step: Optional[int] = None) -> None:
+                     ckpt_step: Optional[int] = None,
+                     serve: Optional[Dict[str, float]] = None) -> None:
         t = self.task(job_type, index)
         t.touch()
         if ckpt_step is not None:
             t.ckpt_step = int(ckpt_step)
+        if serve:
+            try:
+                t.serve_metrics = {str(k): float(v)
+                                   for k, v in dict(serve).items()}
+            except (TypeError, ValueError):
+                pass          # malformed telemetry must not sink liveness
+
+    # -- elastic replica scaling (tony_tpu.serve) --------------------------
+    def add_task(self, job_type: str) -> TonyTask:
+        """Append one ELASTIC task to ``job_type`` (the AM's replica
+        scale-up): next free index, flagged so it never gates the gang
+        barrier and is the preferred scale-down victim."""
+        with self.lock:
+            indices = [i for (jt, i) in self._tasks if jt == job_type]
+            if not indices:
+                raise KeyError(f"unknown job type {job_type!r}")
+            idx = max(indices) + 1
+            task = TonyTask(job_type, idx,
+                            tracked=self.conf.is_tracked(job_type),
+                            elastic=True)
+            self._tasks[(job_type, idx)] = task
+            return task
+
+    def mark_scaled_down(self, task: TonyTask, reason: str) -> None:
+        """Terminal KILLED without failing the job — the deliberate
+        scale-down exit (vs LOST/FAILED, which trip the success
+        policy)."""
+        with self.lock:
+            if task.status.is_terminal:
+                return
+            task.status = TaskStatus.KILLED
+            task.exit_code = constants.EXIT_KILLED
+            task.diagnostics = reason
+            task.end_time = time.monotonic()
+
+    def serve_samples(self, job_type: str) -> List[Dict[str, float]]:
+        """Latest serve telemetry per live replica of ``job_type`` —
+        the autoscaler's decision input."""
+        with self.lock:
+            return [dict(t.serve_metrics) for t in self._tasks.values()
+                    if t.job_type == job_type and not t.status.is_terminal
+                    and t.serve_metrics]
 
     def last_committed_step(self) -> Optional[int]:
         """Newest checkpoint step any executor has reported committed —
