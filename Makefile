@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -77,6 +77,17 @@ tier1-analysis:
 # gate and must see it.
 tier1-serve:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Speculative-decoding marker leg — paged-cache spec_reserve/commit/
+# rollback invariants + leak-free randomized accept/reject, the BITWISE
+# greedy-parity pin vs the non-speculative engine (n-gram and model
+# draft lanes, all draft depths), the effective-throughput heartbeat
+# round trip, and the seventh analyze config. Runs the FULL spec
+# selection (slow included): the train→replica spec e2e is slow-marked
+# to keep tier1-verify inside its (already tight — ROADMAP) 870 s
+# budget, but this named leg is the lane's gate and must see it.
+tier1-spec:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m spec -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The jnp.concatenate/stack pack-site lint (the jax-0.4 GSPMD concat-
 # reshard footgun, machine-checked): every call site outside the approved

@@ -250,6 +250,20 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["serve_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+    if os.environ.get("BENCH_SPEC", "1") != "0":
+        # Speculative-decoding leg (tony_tpu.serve.spec): draft-and-
+        # verify vs the plain engine on the SAME Poisson trace as the
+        # serve leg — tokens per target forward, acceptance rate by
+        # draft depth k, p50/p99, and the bitwise token-identity gate.
+        # CPU wall numbers measure scheduling (spec_sim_note); the
+        # forward-count ratios are the machine-independent claim;
+        # BENCH_r13.
+        try:
+            from tony_tpu.benchmark import run_spec_bench
+            result.update(run_spec_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["spec_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
 
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:

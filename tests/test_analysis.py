@@ -72,10 +72,11 @@ class TestShippedConfigsClean:
 
     @pytest.mark.parametrize("name", acli.CONFIG_NAMES)
     def test_clean_with_pinned_signature(self, name):
-        if name == "serve":
-            # The serving plane's decode config builds through its own
-            # target (an engine, not an accum stepper) — run_config is
-            # the shared entry both this gate and the CLI use.
+        if name in ("serve", "spec"):
+            # The serving plane's decode/verify configs build through
+            # their own targets (an engine, not an accum stepper) —
+            # run_config is the shared entry both this gate and the CLI
+            # use.
             report = acli.run_config(
                 name, signature_path=SIG_DIR / f"{name}.json")
         else:

@@ -300,8 +300,15 @@ class TestEngine:
         eng.run()
         stats = eng.stats()
         for key in ("qps", "p50_ms", "p99_ms", "queue_depth",
-                    "tokens_per_s", "forwards"):
+                    "tokens_per_s", "forwards", "tokens_per_forward",
+                    "acceptance_rate"):
             assert key in stats
+        # Effective throughput (the autoscaler's honest number since the
+        # speculative lane): generated tokens per forward launch — this
+        # run emitted 2 tokens (max_new_tokens=2).
+        assert stats["tokens_per_forward"] == pytest.approx(
+            2.0 / stats["forwards"])
+        assert stats["acceptance_rate"] == 0.0
         report = profiler.serve_report()
         assert report["serve_test"]["ctx_pad"] == eng.ctx_pad
         assert report["serve_test_stats"]["completed"] == 1.0

@@ -144,7 +144,8 @@ def analyze_jaxpr(closed: Any, *, expected: Sequence[Expected] = (),
 def analyze_serve_step(engine: Any, *, waivers: Sequence[Waiver] = (),
                        tag: str = "serve",
                        signature_path: Optional[str] = None,
-                       batch: Optional[int] = None) -> AnalysisReport:
+                       batch: Optional[int] = None,
+                       step: str = "decode") -> AnalysisReport:
     """Analyze a :class:`tony_tpu.serve.ServeEngine` decode step — the
     serving plane's day-one planner registration made auditable.
 
@@ -156,8 +157,19 @@ def analyze_serve_step(engine: Any, *, waivers: Sequence[Waiver] = (),
     reshard/gather surfaces as a rule-2 finding, not a latency mystery.
     Dtype policy (rule 3) and donation (rule 4 — the KV pools must be
     donated or every step doubles the cache's residency) run as on the
-    accum steps; ``signature_path`` pins the digest (rule 5)."""
-    jitted, args = engine.decode_traced(batch)
+    accum steps; ``signature_path`` pins the digest (rule 5).
+
+    ``step="verify"`` audits a :class:`tony_tpu.serve.SpecEngine`'s
+    one-launch k-token verification through its ``verify_traced`` hook
+    instead — the same rule suite over the speculative lane's hot path
+    (zero collectives on a replica mesh, KV-pool donation, pinned
+    signature), with the spec geometry in the report config."""
+    if step == "verify":
+        jitted, args = engine.verify_traced(batch)
+    elif step == "decode":
+        jitted, args = engine.decode_traced(batch)
+    else:
+        raise ValueError(f"unknown serve step {step!r} (decode|verify)")
     traced = jitted.trace(*args)
     closed = traced.jaxpr
     donate_argnums = tuple(getattr(traced, "donate_argnums", ()) or ())
@@ -202,12 +214,15 @@ def analyze_serve_step(engine: Any, *, waivers: Sequence[Waiver] = (),
                 provenance=str(signature_path)))
     active, waived = apply_waivers(findings, waivers)
     config = {
-        "plane": "serve_decode", "ctx_pad": engine.ctx_pad,
+        "plane": f"serve_{step}", "ctx_pad": engine.ctx_pad,
         "block_size": engine.block_size, "q_block": engine.q_block,
         "n_blocks": engine.cache.n_blocks,
         "decode_buckets": list(engine.decode_buckets),
         "donate_argnums": list(donate_argnums),
     }
+    if step == "verify":
+        config["spec_k"] = int(engine.spec_k)
+        config["draft"] = getattr(engine.draft, "kind", "?")
     report = AnalysisReport(
         tag=tag, findings=tuple(active), waived=tuple(waived),
         collectives=tuple(colls), signature=sig, config=config)

@@ -103,6 +103,36 @@ def cmd_serve(args: argparse.Namespace) -> int:
         cfg.set(conf_mod.SERVE_MESH, args.mesh)
     if args.max_replicas is not None:
         cfg.set(conf_mod.SERVE_REPLICAS_MAX, str(args.max_replicas))
+    # Speculative decoding lane: --spec_k arms draft-and-verify; a named
+    # --draft_model restores a second (smaller) ckpt next to the target,
+    # otherwise the self-drafting n-gram fallback runs. Validate the
+    # flag COMBINATIONS at submit, not replica launch: a draft flag that
+    # silently dropped would serve the wrong lane without a word.
+    if args.spec_k and not 1 <= args.spec_k <= 15:
+        # The replica's row block is q_block=16 and the k+1 verify rows
+        # must fit it (SpecEngine enforces the same bound at launch).
+        raise SystemExit(f"--spec_k must be in [1, 15] (k+1 verify rows "
+                         f"ride the 16-row block), got {args.spec_k}")
+    for flag, val in (("--draft_model_kwargs", args.draft_model_kwargs),
+                      ("--draft_ckpt_dir", args.draft_ckpt_dir)):
+        if val and not args.draft_model:
+            raise SystemExit(f"{flag} needs --draft_model (without one "
+                             f"the replica runs the n-gram self-draft "
+                             f"and the flag would be silently ignored)")
+    if args.spec_k:
+        cfg.set(conf_mod.SERVE_SPEC_K, str(args.spec_k))
+    if args.draft_model:
+        if not args.spec_k:
+            raise SystemExit("--draft_model needs --spec_k > 0 (the "
+                             "draft depth arms the speculative lane)")
+        cfg.set(conf_mod.SERVE_DRAFT_MODEL, args.draft_model)
+        if args.draft_model_kwargs:
+            json_mod.loads(args.draft_model_kwargs)  # validate at submit
+            cfg.set(conf_mod.SERVE_DRAFT_MODEL_KWARGS,
+                    args.draft_model_kwargs)
+        if args.draft_ckpt_dir:
+            cfg.set(conf_mod.SERVE_DRAFT_CKPT_DIR,
+                    str(Path(args.draft_ckpt_dir).resolve()))
     cfg.merge_overrides(_parse_conf_overrides(args.conf or []))
     client = TonyClient(cfg, workdir=args.workdir, am_host=args.am_host,
                         quiet=args.quiet)
@@ -315,6 +345,18 @@ def make_parser() -> argparse.ArgumentParser:
                     help="max positions per sequence (KV buffer extent)")
     sv.add_argument("--mesh", help="JSON MeshSpec kwargs for each "
                     "replica's own mesh (e.g. '{\"fsdp\": 2}')")
+    sv.add_argument("--spec_k", type=int, default=0,
+                    help="speculative decoding draft depth (0 = off; "
+                         "k tokens drafted, verified in ONE target "
+                         "forward — greedy outputs stay bitwise "
+                         "identical)")
+    sv.add_argument("--draft_model", help="registered draft model name "
+                    "(omit for the self-drafting n-gram fallback)")
+    sv.add_argument("--draft_model_kwargs",
+                    help="JSON dict of draft model kwargs")
+    sv.add_argument("--draft_ckpt_dir",
+                    help="draft model checkpoint dir (default: the "
+                         "target's --ckpt_dir)")
     sv.add_argument("--conf_file", help="tony.xml / JSON job config")
     sv.add_argument("--conf", action="append", metavar="KEY=VALUE")
     sv.add_argument("--name", help="application name")

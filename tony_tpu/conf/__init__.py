@@ -120,6 +120,16 @@ SERVE_QUEUE_HIGH = "tony.serve.scale.queue-high"
 SERVE_QUEUE_LOW = "tony.serve.scale.queue-low"
 SERVE_P99_HIGH_MS = "tony.serve.scale.p99-high-ms"
 SERVE_COOLDOWN_S = "tony.serve.scale.cooldown-s"
+# Speculative decoding lane (tony_tpu.serve.spec): spec-k > 0 turns the
+# replica's engine into the draft-and-verify SpecEngine. With a draft
+# model name it restores a second (smaller, optionally quant=-laned)
+# transformer through the same elastic-restore path; without one the
+# self-drafting n-gram fallback runs — no second checkpoint needed.
+SERVE_SPEC_K = "tony.serve.spec-k"              # draft depth (0 = off)
+SERVE_DRAFT_MODEL = "tony.serve.draft.model"    # registered draft model
+SERVE_DRAFT_MODEL_KWARGS = "tony.serve.draft.model-kwargs"  # JSON kwargs
+SERVE_DRAFT_CKPT_DIR = "tony.serve.draft.ckpt-dir"  # draft training ckpt
+SERVE_DRAFT_NGRAM_MAX = "tony.serve.draft.ngram-max"  # fallback n-gram n
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

@@ -203,6 +203,13 @@ class Attention(nn.Module):
             # commit into the paged pool. Projections are the SAME
             # denses as training — the quant= lanes ride along — so a
             # training checkpoint serves without any param surgery.
+            # Prefill, decode, AND speculative k+1-row verification
+            # (serve.spec) are all this one branch at different real-row
+            # counts: the scatter-before-attend order is what lets a
+            # verify row attend the draft rows below it in the same
+            # launch, and the in-buffer overwrite of positions >= each
+            # row's own block start is what makes rolled-back (stale)
+            # pool rows unreadable by construction.
             k_buf, v_buf = kv
             pos = positions.astype(jnp.int32)
             q4 = rope(q.reshape(b, t, nh, hd), pos, cfg.rope_theta,

@@ -1159,6 +1159,17 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
 # prefill block, a decode block, or a differently-joined batch (the
 # engine keeps all row counts at sublane-tile multiples — single-row
 # GEMV paths are the one place XLA CPU breaks row invariance).
+#
+# The speculative lane (tony_tpu.serve.spec) leans on the same contract
+# from a third direction: its one-launch k-token verification is a
+# decode-shaped call whose q-block carries k+1 REAL rows at consecutive
+# positions p0..p0+k (the engine scatters all k+1 candidate KV rows into
+# the buffer first, so row j attends the draft rows below it). Because
+# each row's mask is its own absolute position and every op is
+# row-independent, verify row j is bit-identical to the plain decode row
+# at position p0+j — which is exactly what makes greedy accept/reject
+# reproduce sequential greedy decode bit for bit, with rejected rows
+# never read (they sit above every surviving row's position).
 # --------------------------------------------------------------------
 
 

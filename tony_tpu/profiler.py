@@ -229,10 +229,14 @@ def reset_quant_records() -> None:
 # Serving-plane instrumentation (tony_tpu.serve): the engine records its
 # build-time geometry (context extent, block pool size, row block,
 # decode buckets, join policy) under the engine tag and its live
-# telemetry — the heartbeat triple qps/p99/queue-depth plus rates — under
-# "<tag>_stats"; the replica banks restore geometry under "replica".
-# Keyed by tag; last record per tag wins. run_serve_bench serializes
-# this next to the other records (BENCH_r12).
+# telemetry — the heartbeat triple qps/p99/queue-depth plus rates, and
+# since the speculative lane (serve.spec) also tokens_per_forward,
+# acceptance_rate, proposed/accepted token counts, and verify-launch
+# counts — under "<tag>_stats"; the speculative geometry (draft kind,
+# depth k) under "<tag>_spec"; the replica banks restore geometry under
+# "replica". Keyed by tag; last record per tag wins. run_serve_bench /
+# run_spec_bench serialize this next to the other records
+# (BENCH_r12/r13).
 SERVE_RECORDS: Dict[str, Dict[str, object]] = {}
 
 

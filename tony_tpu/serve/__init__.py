@@ -22,7 +22,13 @@ the execution loop watches every user rebuild it badly):
   the existing TCP proxy), and qps/p99/queue-depth ride the executor
   heartbeat so the AM can scale replicas against load;
 * :mod:`~tony_tpu.serve.scaling` — the pure (jax-free) replica-scaling
-  policy the AM's monitor loop applies.
+  policy the AM's monitor loop applies;
+* :mod:`~tony_tpu.serve.spec` — the speculative decoding lane: a draft
+  lane (second small model, or the self-drafting n-gram fallback)
+  proposes k tokens and the target verifies all k+1 positions in ONE
+  forward through the same ``q_block`` row-block step the decode loop
+  runs — greedy-path token streams and logits stay BITWISE identical to
+  the non-speculative engine while tokens-per-forward multiplies.
 
 Numerics contract: continuous-batching decode is BIT-identical to a
 sequential full prefill of the same tokens — every op in the serve
@@ -34,8 +40,9 @@ logits. ``tests/test_serve.py`` pins this end to end.
 
 from typing import Any
 
-__all__ = ["AdmissionError", "Completion", "PagedKVCache", "Request",
-           "ServeEngine", "engine", "kvcache", "replica", "scaling"]
+__all__ = ["AdmissionError", "Completion", "ModelDraft", "NgramDraft",
+           "PagedKVCache", "Request", "ServeEngine", "SpecEngine",
+           "engine", "kvcache", "replica", "scaling", "spec"]
 
 # LAZY facade (PEP 562, like tony_tpu.analysis): the engine pulls jax,
 # but the AM's autoscaler only needs the pure scaling policy and the
@@ -46,7 +53,9 @@ __all__ = ["AdmissionError", "Completion", "PagedKVCache", "Request",
 _LAZY = {
     "AdmissionError": "kvcache", "PagedKVCache": "kvcache",
     "Completion": "engine", "Request": "engine", "ServeEngine": "engine",
+    "ModelDraft": "spec", "NgramDraft": "spec", "SpecEngine": "spec",
     "engine": None, "kvcache": None, "replica": None, "scaling": None,
+    "spec": None,
 }
 
 

@@ -101,7 +101,116 @@ def _bucket_of(buckets: Sequence[int], n: int) -> int:
                      f"{max(buckets)}")
 
 
-class ServeEngine:
+def build_step_fn(model: Any, *, n_layers: int, n_blocks: int,
+                  block_size: int, kv_dim: int, ctx_pad: int, b: int,
+                  t: int) -> Callable:
+    """The (b, t)-shaped jitted serve step over a paged pool: gather
+    each sequence's blocks into the fixed-extent KV buffers, run the
+    serve forward, commit the fresh rows back to the pool through the
+    host-computed flat scatter indices (OOB rows drop). Pools are
+    donated — callers immediately rebind them, so the update is
+    in-place-ish.
+
+    Module-level so the speculative lane's draft model
+    (:mod:`tony_tpu.serve.spec`) runs the IDENTICAL program over its own
+    pool: one builder, one jaxpr shape family, one signature pin."""
+    L, nb, bs, kvd, ctx = n_layers, n_blocks, block_size, kv_dim, ctx_pad
+
+    def fn(params, pool_k, pool_v, tokens, positions, tables,
+           flat_idx):
+        # mode="clip", NOT the default NaN-fill: table padding (and
+        # the scratch reference's contiguous table on a small pool)
+        # may point past the pool, and those positions are masked by
+        # the attention — but only 0 x FINITE is exactly 0; a
+        # NaN-filled block would poison every masked row.
+        kbuf = jnp.take(pool_k, tables, axis=1,
+                        mode="clip").reshape(L, b, ctx, kvd)
+        vbuf = jnp.take(pool_v, tables, axis=1,
+                        mode="clip").reshape(L, b, ctx, kvd)
+        logits, (knew, vnew) = model.apply(
+            {"params": params}, tokens, positions=positions,
+            kv=(kbuf, vbuf))
+        pk = pool_k.reshape(L, nb * bs, kvd).at[:, flat_idx].set(
+            knew.astype(pool_k.dtype), mode="drop")
+        pv = pool_v.reshape(L, nb * bs, kvd).at[:, flat_idx].set(
+            vnew.astype(pool_v.dtype), mode="drop")
+        return (logits, pk.reshape(L, nb, bs, kvd),
+                pv.reshape(L, nb, bs, kvd))
+
+    return jax.jit(fn, donate_argnums=(1, 2))
+
+
+class PagedModelRunner:
+    """Shared geometry + jitted-step plumbing over ONE model and ONE
+    paged KV pool: the base of both the serve engine and the
+    speculative lane's draft model (:class:`tony_tpu.serve.spec.
+    ModelDraft`). Owning it here keeps the two lanes on one jit cache
+    shape, one mesh/donation discipline, and one forward counter idiom —
+    a change to how a step runs cannot drift between them."""
+
+    def _init_paged(self, model: Any, params: Any, *, ctx_max: int,
+                    block_size: int, q_block: int,
+                    decode_buckets: Sequence[int], max_running: int,
+                    n_blocks: Optional[int], mesh: Optional[Any]) -> None:
+        cfg = model.cfg
+        if q_block % 8:
+            raise ValueError(f"q_block must be a sublane-tile multiple "
+                             f"(8), got {q_block}")
+        self.model = model
+        self.params = params
+        self.mesh = mesh
+        self.q_block = int(q_block)
+        self.decode_buckets = tuple(sorted(set(
+            list(decode_buckets) + [max_running])))
+        self.max_running = int(max_running)
+        self.n_layers = cfg.n_layers
+        self.kv_dim = cfg.n_kv_heads * cfg.head_dim
+        self.block_size = int(block_size)
+        nb_max = -(-int(ctx_max) // self.block_size)
+        self.nb_max = nb_max
+        self.ctx_pad = nb_max * self.block_size
+        if n_blocks is None:
+            n_blocks = nb_max * self.max_running
+        self.cache = PagedKVCache(self.n_layers, self.kv_dim,
+                                  n_blocks=n_blocks,
+                                  block_size=self.block_size,
+                                  dtype=cfg.dtype)
+        self._fns: Dict[Tuple[int, int], Callable] = {}
+        # Forward-launch counter (prefills + decode/verify steps): the
+        # machine-independent cost of a schedule — on an accelerator the
+        # forward dominates wall time, so fewer launches for the same
+        # tokens IS the batching/speculation win.
+        self.forwards = 0
+
+    def _fn(self, b: int, t: int) -> Callable:
+        """The cached view of :func:`build_step_fn` — prefill, decode,
+        AND the speculative lane's k+1-row verification all share these
+        entries (verification is a decode-shaped launch with more real
+        rows, so it adds zero compiles)."""
+        key = (b, t)
+        if key not in self._fns:
+            self._fns[key] = build_step_fn(
+                self.model, n_layers=self.n_layers,
+                n_blocks=self.cache.n_blocks, block_size=self.block_size,
+                kv_dim=self.kv_dim, ctx_pad=self.ctx_pad, b=b, t=t)
+        return self._fns[key]
+
+    def _run_fn(self, b, t, tokens, positions, tables, flat_idx):
+        fn = self._fn(b, t)
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(flat_idx))
+        if self.mesh is not None:
+            with mesh_context(self.mesh):
+                logits, pk, pv = fn(*args)
+        else:
+            logits, pk, pv = fn(*args)
+        self.cache.k, self.cache.v = pk, pv
+        self.forwards += 1
+        return logits
+
+
+class ServeEngine(PagedModelRunner):
     """Continuous-batching loop for one replica.
 
     ``model`` is a serve-capable flax module (today:
@@ -118,39 +227,20 @@ class ServeEngine:
                  max_running: int = 16, mesh: Optional[Any] = None,
                  keep_logits: bool = False, join_policy: str = "continuous",
                  stats_window_s: float = 60.0, tag: str = "serve"):
-        cfg = model.cfg
-        if q_block % 8:
-            raise ValueError(f"q_block must be a sublane-tile multiple "
-                             f"(8), got {q_block}")
         if join_policy not in ("continuous", "static"):
             raise ValueError(f"unknown join_policy {join_policy!r} "
                              "(continuous|static)")
-        self.model = model
-        self.params = params
-        self.mesh = mesh
-        self.q_block = int(q_block)
+        self._init_paged(model, params, ctx_max=ctx_max,
+                         block_size=block_size, q_block=q_block,
+                         decode_buckets=decode_buckets,
+                         max_running=max_running, n_blocks=n_blocks,
+                         mesh=mesh)
         self.keep_logits = keep_logits
         self.join_policy = join_policy
         self.tag = tag
-        self.decode_buckets = tuple(sorted(set(
-            list(decode_buckets) + [max_running])))
-        self.max_running = int(max_running)
-        self.n_layers = cfg.n_layers
-        self.kv_dim = cfg.n_kv_heads * cfg.head_dim
-        self.block_size = int(block_size)
-        nb_max = -(-int(ctx_max) // self.block_size)
-        self.nb_max = nb_max
-        self.ctx_pad = nb_max * self.block_size
-        if n_blocks is None:
-            n_blocks = nb_max * self.max_running
-        self.cache = PagedKVCache(self.n_layers, self.kv_dim,
-                                  n_blocks=n_blocks,
-                                  block_size=self.block_size,
-                                  dtype=cfg.dtype)
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._running: List[_Seq] = []
-        self._fns: Dict[Tuple[int, int], Callable] = {}
         # Telemetry: completion ring for p50/p99, monotonic counters for
         # rates — O(1) per step, million-request safe.
         # (t_done, latency_s, n_tokens) per completion: rates and
@@ -160,14 +250,10 @@ class ServeEngine:
         self._events: deque = deque(maxlen=512)
         self.stats_window_s = float(stats_window_s)
         self._completed = 0
-        self._tokens_out = 0
+        self._tokens_out = 0           # tokens of COMPLETED requests
+        self._emitted = 0              # every generated token, at emit
         self._t0 = time.monotonic()
         self._steps = 0
-        # Forward-launch counter (prefills + decode steps): the
-        # machine-independent cost of a schedule — on an accelerator the
-        # forward dominates wall time, so fewer launches for the same
-        # tokens IS the continuous-batching win.
-        self.forwards = 0
         self.register_plan()
 
     # -- planner/profiler registration ------------------------------------
@@ -228,60 +314,6 @@ class ServeEngine:
     def running(self) -> int:
         return len(self._running)
 
-    # -- jitted forward family --------------------------------------------
-    def _fn(self, b: int, t: int) -> Callable:
-        """The (b, t)-shaped jitted step: gather each sequence's blocks
-        into the fixed-extent KV buffers, run the serve forward, commit
-        the fresh rows back to the pool through the host-computed flat
-        scatter indices (OOB rows drop). Pools are donated — the engine
-        immediately rebinds them, so the update is in-place-ish."""
-        key = (b, t)
-        if key in self._fns:
-            return self._fns[key]
-        L, nb, bs, kvd = (self.n_layers, self.cache.n_blocks,
-                          self.block_size, self.kv_dim)
-        ctx = self.ctx_pad
-        model = self.model
-
-        def fn(params, pool_k, pool_v, tokens, positions, tables,
-               flat_idx):
-            # mode="clip", NOT the default NaN-fill: table padding (and
-            # the scratch reference's contiguous table on a small pool)
-            # may point past the pool, and those positions are masked by
-            # the attention — but only 0 x FINITE is exactly 0; a
-            # NaN-filled block would poison every masked row.
-            kbuf = jnp.take(pool_k, tables, axis=1,
-                            mode="clip").reshape(L, b, ctx, kvd)
-            vbuf = jnp.take(pool_v, tables, axis=1,
-                            mode="clip").reshape(L, b, ctx, kvd)
-            logits, (knew, vnew) = model.apply(
-                {"params": params}, tokens, positions=positions,
-                kv=(kbuf, vbuf))
-            pk = pool_k.reshape(L, nb * bs, kvd).at[:, flat_idx].set(
-                knew.astype(pool_k.dtype), mode="drop")
-            pv = pool_v.reshape(L, nb * bs, kvd).at[:, flat_idx].set(
-                vnew.astype(pool_v.dtype), mode="drop")
-            return (logits, pk.reshape(L, nb, bs, kvd),
-                    pv.reshape(L, nb, bs, kvd))
-
-        jitted = jax.jit(fn, donate_argnums=(1, 2))
-        self._fns[key] = jitted
-        return jitted
-
-    def _run_fn(self, b, t, tokens, positions, tables, flat_idx):
-        fn = self._fn(b, t)
-        args = (self.params, self.cache.k, self.cache.v,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(tables), jnp.asarray(flat_idx))
-        if self.mesh is not None:
-            with mesh_context(self.mesh):
-                logits, pk, pv = fn(*args)
-        else:
-            logits, pk, pv = fn(*args)
-        self.cache.k, self.cache.v = pk, pv
-        self.forwards += 1
-        return logits
-
     # -- prefill -----------------------------------------------------------
     def _prefill(self, seq: _Seq) -> None:
         t_real = len(seq.tokens)
@@ -324,6 +356,7 @@ class ServeEngine:
             seq.logits.append(row.copy())
         seq.tokens.append(int(np.argmax(row)))   # greedy: deterministic
         seq.remaining -= 1
+        self._emitted += 1
 
     # -- scheduling --------------------------------------------------------
     def _join(self, results: List[Completion]) -> None:
@@ -456,9 +489,27 @@ class ServeEngine:
             "completed": float(self._completed),
             "steps": float(self._steps),
             "forwards": float(self.forwards),
+            # Effective throughput for the autoscaler: generated tokens
+            # per TARGET forward launch (lifetime), counted at EMIT time
+            # so a replica mid-way through long generations reports what
+            # it is actually producing, not zero until first completion.
+            # Raw forward counts undercount a speculative replica's real
+            # throughput — ScalingPolicy's decision matrix is unchanged,
+            # but the heartbeat now carries the honest number (the
+            # speculative lane also reports its acceptance rate; 0.0
+            # here).
+            "tokens_per_forward": (self._emitted / self.forwards
+                                   if self.forwards else 0.0),
+            "acceptance_rate": 0.0,
         }
+        stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
         return stats
+
+    def _extra_stats(self) -> Dict[str, float]:
+        """Subclass hook (tony_tpu.serve.spec overrides): extra fields
+        merged into :meth:`stats` before it is recorded/published."""
+        return {}
 
     def write_stats(self, path: str) -> None:
         """Atomically publish :meth:`stats` as JSON — the file the

@@ -11,6 +11,18 @@ position is written before any query can attend it (the flash-decode
 mask admits key ``j`` only for rows at position ``>= j``), so stale
 bytes are provably unread — and the reuse test pins that.
 
+Speculative decoding (tony_tpu.serve.spec) adds a second, revocable
+allocation tier on top: :meth:`~PagedKVCache.spec_reserve` grows a
+table to cover drafted-but-unverified positions, :meth:`commit`
+advances the per-sequence *write cursor* to the accepted length
+(promoting the blocks that cover it), and :meth:`rollback` truncates
+the block table back to the committed extent, returning the rejected
+extension to the free list in reverse order — so the LIFO reuse
+contract holds for speculation too. Rollback is free on the device
+side for the same stale-bytes reason: rows written at rejected
+positions sit above every committed row's position and are simply
+never gathered before the regenerating step overwrites them.
+
 Capacity failures are a typed :class:`AdmissionError` carrying the
 needed/free block counts — an admission-control signal the engine (or a
 load balancer above it) can act on, categorically different from an
@@ -60,6 +72,13 @@ class PagedKVCache:
         # pressure.
         self._free: List[int] = list(range(n_blocks - 1, -1, -1))
         self._tables: Dict[Any, List[int]] = {}
+        # Speculative tier (tony_tpu.serve.spec): per-sequence list of
+        # blocks added by spec_reserve and not yet commit-promoted, plus
+        # the write cursor — the highest position VERIFIED written (the
+        # boundary below which pool bytes are trustworthy; rows above it
+        # are drafts that may be rolled back).
+        self._spec: Dict[Any, List[int]] = {}
+        self._committed: Dict[Any, int] = {}
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -78,6 +97,13 @@ class PagedKVCache:
         engine reserves a request's FULL extent (prompt + max new
         tokens) at admission, so decode can never hit pool exhaustion
         mid-flight."""
+        if self._spec.get(seq_id):
+            # A permanent grow would interleave with the revocable tail
+            # and rollback could no longer truncate by suffix.
+            raise ValueError(
+                f"sequence {seq_id!r} holds an uncommitted speculative "
+                f"extension — commit() or rollback() it before a "
+                f"permanent reserve")
         table = self._tables.setdefault(seq_id, [])
         needed = self.blocks_for(length) - len(table)
         if needed > len(self._free):
@@ -90,9 +116,75 @@ class PagedKVCache:
             table.append(self._free.pop())
         return list(table)
 
+    # -- speculative tier (tony_tpu.serve.spec) ----------------------------
+    def committed_len(self, seq_id: Any) -> int:
+        """The write cursor: positions ``[0, committed_len)`` hold
+        verified rows; anything above is a revocable draft."""
+        return self._committed.get(seq_id, 0)
+
+    def spec_reserve(self, seq_id: Any, length: int) -> List[int]:
+        """Grow ``seq_id``'s table to cover ``length`` positions as a
+        REVOCABLE extension: blocks added here are tracked separately so
+        :meth:`rollback` can return exactly them. Raises
+        :class:`AdmissionError` (state unchanged) on pool pressure. A
+        table that already covers ``length`` (the engine's full-extent
+        admission reservation) grows nothing — the call then only
+        asserts coverage, and the later commit/rollback pair maintains
+        the write cursor."""
+        table = self._tables.setdefault(seq_id, [])
+        needed = self.blocks_for(length) - len(table)
+        if needed > len(self._free):
+            raise AdmissionError(
+                f"KV pool exhausted: sequence {seq_id!r} needs {needed} "
+                f"more block(s) for a {length}-position speculative "
+                f"extension, {len(self._free)} free of {self.n_blocks}",
+                needed_blocks=needed, free_blocks=len(self._free))
+        if needed > 0:
+            added = [self._free.pop() for _ in range(needed)]
+            table.extend(added)
+            self._spec.setdefault(seq_id, []).extend(added)
+        return list(table)
+
+    def commit(self, seq_id: Any, length: int) -> None:
+        """Advance the write cursor to ``length`` (the accepted length),
+        promoting the speculative blocks that cover it to permanent.
+        Never moves the cursor backwards; ``length`` must already be
+        covered by the table."""
+        table = self._tables.get(seq_id, [])
+        need = self.blocks_for(length)
+        if need > len(table):
+            raise ValueError(
+                f"cannot commit {length} positions for {seq_id!r}: only "
+                f"{len(table)} block(s) reserved "
+                f"({len(table) * self.block_size} positions)")
+        spec = self._spec.get(seq_id, [])
+        promote = max(0, need - (len(table) - len(spec)))
+        if promote:
+            self._spec[seq_id] = spec[promote:]
+        self._committed[seq_id] = max(self._committed.get(seq_id, 0),
+                                      int(length))
+
+    def rollback(self, seq_id: Any) -> int:
+        """Truncate ``seq_id``'s table back to its committed extent:
+        every still-speculative block returns to the free list in
+        reverse allocation order (so the LIFO handout order is the
+        mirror of the allocation — the reuse test pins it). The write
+        cursor is untouched: it already names the accepted length.
+        Returns the number of blocks freed (0 when the reservation was
+        full-extent and speculation grew nothing)."""
+        spec = self._spec.pop(seq_id, [])
+        if spec:
+            table = self._tables[seq_id]
+            del table[len(table) - len(spec):]
+            self._free.extend(reversed(spec))
+        return len(spec)
+
     def free_seq(self, seq_id: Any) -> int:
-        """Return all of ``seq_id``'s blocks to the pool; returns the
-        count (0 for an unknown id — idempotent eviction)."""
+        """Return all of ``seq_id``'s blocks to the pool — the
+        speculative tail included; returns the count (0 for an unknown
+        id — idempotent eviction)."""
+        self._spec.pop(seq_id, None)
+        self._committed.pop(seq_id, None)
         table = self._tables.pop(seq_id, [])
         self._free.extend(reversed(table))
         return len(table)

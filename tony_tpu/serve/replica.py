@@ -36,9 +36,11 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
-                           SERVE_CTX_MAX, SERVE_DTYPE_POLICY,
+                           SERVE_CTX_MAX, SERVE_DRAFT_CKPT_DIR,
+                           SERVE_DRAFT_MODEL, SERVE_DRAFT_MODEL_KWARGS,
+                           SERVE_DRAFT_NGRAM_MAX, SERVE_DTYPE_POLICY,
                            SERVE_MAX_RUNNING, SERVE_MESH, SERVE_MODEL,
-                           SERVE_MODEL_KWARGS, SERVE_PORT)
+                           SERVE_MODEL_KWARGS, SERVE_PORT, SERVE_SPEC_K)
 from tony_tpu.serve.engine import Completion, Request, ServeEngine
 
 
@@ -51,23 +53,79 @@ class Replica:
                  mesh: Optional[Any] = None, ctx_max: int = 2048,
                  block_size: int = 16, q_block: int = 16,
                  n_blocks: Optional[int] = None, max_running: int = 16,
-                 keep_logits: bool = False, tag: str = "serve"):
+                 keep_logits: bool = False, tag: str = "serve",
+                 spec_k: int = 0,
+                 draft_model_name: Optional[str] = None,
+                 draft_model_kwargs: Optional[Dict[str, Any]] = None,
+                 draft_ckpt_dir: Optional[str] = None,
+                 ngram_max: int = 3):
+        from tony_tpu._trace import trace_record
+        from tony_tpu.models import get_model
+
+        self.model = get_model(model_name, **(model_kwargs or {}))
+        self.mesh = mesh
+        params, step, prefix = self._restore_params(
+            self.model, ckpt_dir, dtype_policy=dtype_policy, mesh=mesh,
+            q_block=q_block)
+        self.restored_step = step
+        if spec_k:
+            # Speculative lane (tony_tpu.serve.spec): draft-and-verify.
+            # A named draft model restores through the SAME elastic path
+            # as the target (its own ckpt dir, or the target's when the
+            # two share a save); no draft model = self-drafting n-gram.
+            from tony_tpu.serve.spec import SpecEngine
+
+            draft_kw: Dict[str, Any] = {"ngram_max": ngram_max}
+            if draft_model_name:
+                draft_model = get_model(draft_model_name,
+                                        **(draft_model_kwargs or {}))
+                draft_params, draft_step, _ = self._restore_params(
+                    draft_model, draft_ckpt_dir or ckpt_dir,
+                    dtype_policy=dtype_policy, mesh=mesh, q_block=q_block)
+                draft_kw.update(draft_model=draft_model,
+                                draft_params=draft_params)
+                self.draft_restored_step = draft_step
+            self.engine = SpecEngine(
+                self.model, params, spec_k=spec_k, ctx_max=ctx_max,
+                block_size=block_size, q_block=q_block, n_blocks=n_blocks,
+                max_running=max_running, mesh=mesh,
+                keep_logits=keep_logits, tag=tag, **draft_kw)
+        else:
+            self.engine = ServeEngine(
+                self.model, params, ctx_max=ctx_max,
+                block_size=block_size, q_block=q_block, n_blocks=n_blocks,
+                max_running=max_running, mesh=mesh,
+                keep_logits=keep_logits, tag=tag)
+        trace_record("serve", "replica", model=model_name,
+                     ckpt_step=step, path_prefix=prefix,
+                     dtype_policy=dtype_policy, spec_k=int(spec_k),
+                     draft_model=draft_model_name or
+                     ("ngram" if spec_k else None),
+                     mesh_axes=dict(getattr(mesh, "shape", {}) or {}))
+        self._drive = threading.Lock()
+        self._done: Dict[Any, Completion] = {}
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+
+    @staticmethod
+    def _restore_params(model: Any, ckpt_dir: str, *,
+                        dtype_policy: Optional[str], mesh: Optional[Any],
+                        q_block: int):
+        """Elastic params-only restore onto the replica's mesh — shared
+        by the target and the speculative lane's draft model (both are
+        trained checkpoints; neither may initialize fresh weights)."""
         import flax.linen as nn
         import jax
         import jax.numpy as jnp
 
         from tony_tpu import ckpt
-        from tony_tpu._trace import trace_record
         from tony_tpu.compat import mesh_context
-        from tony_tpu.models import get_model
 
-        self.model = get_model(model_name, **(model_kwargs or {}))
-        self.mesh = mesh
         sample = jnp.zeros((1, q_block), jnp.int32)
 
         def init():
-            return nn.unbox(self.model.init(jax.random.PRNGKey(0),
-                                            sample))["params"]
+            return nn.unbox(model.init(jax.random.PRNGKey(0),
+                                       sample))["params"]
 
         # Template init: structure/shapes only — every value is replaced
         # by the restore below (and the restore is what the e2e test
@@ -86,19 +144,7 @@ class Replica:
         params = ckpt.restore_pytree(
             ckpt_dir, template, step=step, mesh=mesh,
             dtype_policy=dtype_policy, path_prefix=prefix)
-        self.restored_step = step
-        self.engine = ServeEngine(
-            self.model, params, ctx_max=ctx_max, block_size=block_size,
-            q_block=q_block, n_blocks=n_blocks, max_running=max_running,
-            mesh=mesh, keep_logits=keep_logits, tag=tag)
-        trace_record("serve", "replica", model=model_name,
-                     ckpt_step=step, path_prefix=prefix,
-                     dtype_policy=dtype_policy,
-                     mesh_axes=dict(getattr(mesh, "shape", {}) or {}))
-        self._drive = threading.Lock()
-        self._done: Dict[Any, Completion] = {}
-        self._rid = 0
-        self._rid_lock = threading.Lock()
+        return params, step, prefix
 
     # -- request path ------------------------------------------------------
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
@@ -199,7 +245,13 @@ def main() -> int:
         mesh=mesh,
         ctx_max=conf.get_int(SERVE_CTX_MAX, 2048),
         block_size=conf.get_int(SERVE_BLOCK_SIZE, 16),
-        max_running=conf.get_int(SERVE_MAX_RUNNING, 16))
+        max_running=conf.get_int(SERVE_MAX_RUNNING, 16),
+        spec_k=conf.get_int(SERVE_SPEC_K, 0),
+        draft_model_name=conf.get(SERVE_DRAFT_MODEL),
+        draft_model_kwargs=json.loads(
+            conf.get(SERVE_DRAFT_MODEL_KWARGS) or "{}"),
+        draft_ckpt_dir=conf.get(SERVE_DRAFT_CKPT_DIR),
+        ngram_max=conf.get_int(SERVE_DRAFT_NGRAM_MAX, 3))
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

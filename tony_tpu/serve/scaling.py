@@ -9,6 +9,15 @@ replica per decision, with a cooldown, so the loop can't flap). This
 module is jax-free and side-effect-free on purpose: the decision is unit
 testable without an AM, and the AM glue (``_autoscale_serve``) stays a
 dumb applier.
+
+Since the speculative decoding lane (tony_tpu.serve.spec) the heartbeat
+samples also carry ``tokens_per_forward`` and ``acceptance_rate``, so
+the policy sees a replica's EFFECTIVE throughput rather than raw
+forward counts — a speculative replica emitting 3 tokens per launch is
+not "3x busier" than its forward count suggests. The decision matrix
+below is deliberately unchanged (queue depth and p99 already measure
+user-visible pressure, which is what scaling should act on); the new
+fields ride along for observability and for future SLO-driven policies.
 """
 
 from __future__ import annotations
