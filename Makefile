@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -88,6 +88,18 @@ tier1-serve:
 # budget, but this named leg is the lane's gate and must see it.
 tier1-spec:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m spec -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Routed-serving marker leg — prefix-cache sharing invariants (refcount/
+# COW/LRU partition under a randomized interleave), the BITWISE pins of
+# prefix-cached and chunked-prefill admissions vs the unrouted engine,
+# the cross-replica router (overlap scoring, sticky affinity, failover),
+# the widened heartbeat schema, and the eighth analyze config. Runs the
+# FULL route selection (slow included): the multi-replica e2e and
+# long-prompt chunking tests are slow-marked to keep tier1-verify inside
+# its (tight — ROADMAP) 870 s budget, but this named leg is the lane's
+# gate and must see them.
+tier1-route:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m route -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The jnp.concatenate/stack pack-site lint (the jax-0.4 GSPMD concat-
 # reshard footgun, machine-checked): every call site outside the approved

@@ -265,6 +265,21 @@ def main() -> int:
             result["spec_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
 
+    if os.environ.get("BENCH_ROUTE", "1") != "0":
+        # Routed-serving leg (tony_tpu.serve PR 13): block-level prefix
+        # caching + chunked prefill + the 2-replica routed fleet on a
+        # shared-prefix workload mix — prefill launch/row reduction and
+        # cache hit rate (the machine-independent claims), chunked
+        # on/off p50/p99, routed vs single-replica throughput, and the
+        # token-identity gate in every configuration. CPU wall numbers
+        # measure scheduling (route_sim_note); BENCH_r14.
+        try:
+            from tony_tpu.benchmark import run_route_bench
+            result.update(run_route_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["route_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

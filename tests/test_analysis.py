@@ -72,7 +72,7 @@ class TestShippedConfigsClean:
 
     @pytest.mark.parametrize("name", acli.CONFIG_NAMES)
     def test_clean_with_pinned_signature(self, name):
-        if name in ("serve", "spec"):
+        if name in acli._SERVE_CONFIGS:
             # The serving plane's decode/verify configs build through
             # their own targets (an engine, not an accum stepper) —
             # run_config is the shared entry both this gate and the CLI

@@ -1,0 +1,875 @@
+"""Routed-serving legs (tony_tpu.serve PR 13): block-level prefix
+caching (chain hashing, refcounted adoption, COW, ref-aware LRU over
+the LIFO free tier), chunked prefill, the cross-replica request router
+(overlap scoring, sticky affinity, failover), the widened heartbeat
+schema, and the BITWISE pins of every new admission path against the
+unrouted PR 10/12 engine."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.route
+
+
+# ---------------------------------------------------------------------------
+# Shared tiny model + params (serving is read-only on params).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    import flax.linen as nn
+
+    from tony_tpu.models import get_model
+
+    model = get_model("llama-tiny", n_layers=2)
+    sample = jnp.zeros((1, 16), jnp.int32)
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), sample))["params"]
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        params)
+    return model, params
+
+
+def make_engine(tiny, **kw):
+    from tony_tpu.serve import ServeEngine
+
+    model, params = tiny
+    kw.setdefault("ctx_max", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("q_block", 16)
+    kw.setdefault("decode_buckets", (2, 4))
+    kw.setdefault("max_running", 4)
+    kw.setdefault("keep_logits", True)
+    return ServeEngine(model, params, **kw)
+
+
+def run_requests(eng, prompts, max_new=4, stagger=True):
+    """Submit + drive; staggered submission exercises mid-flight joins
+    (live-donor sharing) the way real traffic would."""
+    from tony_tpu.serve import Request
+
+    done = {}
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, tokens=list(p), max_new_tokens=max_new))
+        if stagger:
+            done.update({c.rid: c for c in eng.step()})
+    done.update({c.rid: c for c in eng.run()})
+    return done
+
+
+def assert_bitwise_equal(got, ref):
+    """Token streams AND per-token logits of two completion maps."""
+    assert sorted(got) == sorted(ref)
+    for rid in ref:
+        assert got[rid].tokens == ref[rid].tokens, rid
+        assert len(got[rid].logits) == len(ref[rid].logits)
+        for a, b in zip(got[rid].logits, ref[rid].logits):
+            assert np.array_equal(a, b), rid
+
+
+# ---------------------------------------------------------------------------
+# Chain hashing (tony_tpu.serve.prefix)
+# ---------------------------------------------------------------------------
+
+class TestPrefixHashing:
+    def test_chain_keys_cover_full_blocks_only(self):
+        from tony_tpu.serve import prefix
+
+        toks = list(range(21))
+        keys = prefix.chain_keys(toks, 8)
+        assert len(keys) == 2                       # 21 // 8
+        assert prefix.chain_keys(toks[:16], 8) == keys
+        assert prefix.chain_keys([], 8) == []
+
+    def test_chain_keys_deterministic_and_prefix_sensitive(self):
+        from tony_tpu.serve import prefix
+
+        a = prefix.chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 4)
+        assert a == prefix.chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 4)
+        # Same second block under a different first block: the chain
+        # key differs — a block is addressable only under its WHOLE
+        # prefix, because its KV rows depend on every earlier token.
+        b = prefix.chain_keys([9, 2, 3, 4, 5, 6, 7, 8], 4)
+        assert a[0] != b[0] and a[1] != b[1]
+        # prior= continues a chain without rehashing history.
+        assert prefix.chain_keys([5, 6, 7, 8], 4, prior=a[0]) == [a[1]]
+
+    def test_match_overlap_is_prefix_not_intersection(self):
+        from tony_tpu.serve import prefix
+
+        keys = ["k0", "k1", "k2"]
+        assert prefix.match_overlap(keys, {"k0", "k1", "k2"}) == 3
+        assert prefix.match_overlap(keys, {"k0", "k2"}) == 1
+        assert prefix.match_overlap(keys, {"k1", "k2"}) == 0
+        assert prefix.match_overlap([], {"k0"}) == 0
+
+
+# ---------------------------------------------------------------------------
+# The prefix tier of the paged KV cache
+# ---------------------------------------------------------------------------
+
+def _cache(n_blocks=12, block_size=4):
+    from tony_tpu.serve import PagedKVCache
+
+    return PagedKVCache(1, 4, n_blocks=n_blocks, block_size=block_size)
+
+
+def _keys(tokens, bs=4):
+    from tony_tpu.serve import prefix
+
+    return prefix.chain_keys(tokens, bs)
+
+
+def _publish_all(c, sid, tokens, bs=4):
+    for i, key in enumerate(_keys(tokens, bs)):
+        c.publish_block(sid, i, key)
+
+
+def check_partition(c):
+    """THE pool invariant: free tier + cached tier + refcounted
+    ownership partition the block ids, and every refcount equals the
+    number of tables holding the block."""
+    owned = {}
+    for t in c.owned_blocks().values():
+        for b in t:
+            owned[b] = owned.get(b, 0) + 1
+    free, lru = set(c._free), set(c.cached_blocks())
+    assert not free & lru
+    assert not (free | lru) & set(owned)
+    assert free | lru | set(owned) == set(range(c.n_blocks))
+    assert {b: c.ref(b) for b in owned} == owned
+    assert set(c._refs) == set(owned)
+
+
+class TestPrefixKVCache:
+    def test_admit_shared_adopts_and_partitions(self):
+        c = _cache()
+        toks = list(range(10))                  # 2 full blocks + tail
+        c.reserve("a", 12)
+        _publish_all(c, "a", toks)
+        matched = c.admit_shared("b", 12, _keys(toks))
+        assert matched == 2
+        ta, tb = c.table("a"), c.table("b")
+        assert tb[:2] == ta[:2] and tb[2] != ta[2]
+        assert c.ref(ta[0]) == 2 and c.ref(ta[2]) == 1
+        assert c.adopted_total == 2
+        check_partition(c)
+
+    def test_admit_shared_atomic_on_pressure(self):
+        c = _cache(n_blocks=4)
+        c.reserve("a", 8)                       # 2 of 4
+        _publish_all(c, "a", list(range(8)))
+        with pytest.raises(Exception) as exc:
+            c.admit_shared("b", 20, _keys(list(range(8))))  # needs 3 fresh
+        from tony_tpu.serve import AdmissionError
+
+        assert isinstance(exc.value, AdmissionError)
+        assert c.table("b") == [] and c.ref(c.table("a")[0]) == 1
+        check_partition(c)
+
+    def test_cow_never_mutates_shared_block(self):
+        c = _cache()
+        toks = list(range(8))
+        c.reserve("a", 8)
+        _publish_all(c, "a", toks)
+        # Distinguishable device bytes in a's block 0.
+        c.k = c.k.at[:, c.table("a")[0]].set(7.0)
+        c.admit_shared("b", 8, _keys(toks))
+        shared = c.table("a")[0]
+        assert c.table("b")[0] == shared and c.ref(shared) == 2
+        idx = c.write_index("b", 1)             # first divergent write
+        priv = c.table("b")[0]
+        assert priv != shared, "COW must repoint, never mutate"
+        assert idx == priv * c.block_size + 1
+        assert c.ref(shared) == 1 and c.ref(priv) == 1
+        # The copy carried the donor's rows; the donor still owns its
+        # original bytes.
+        assert float(c.k[0, priv, 0, 0]) == 7.0
+        assert float(c.k[0, shared, 0, 0]) == 7.0
+        assert c.cow_total == 1
+        # Writes into an exclusively-owned block never copy.
+        assert c.write_index("a", 2) == shared * c.block_size + 2
+        assert c.cow_total == 1
+        check_partition(c)
+
+    def test_free_retires_published_blocks_to_lru_and_revives(self):
+        c = _cache()
+        toks = list(range(8))
+        c.reserve("a", 10)                      # 3 blocks, 2 publishable
+        _publish_all(c, "a", toks)
+        c.free_seq("a")
+        assert len(c.cached_blocks()) == 2      # published pair, cached
+        assert c.free_blocks == c.n_blocks      # both tiers count
+        matched = c.admit_shared("b", 8, _keys(toks))
+        assert matched == 2 and c.revived_total == 2
+        assert not c.cached_blocks()
+        check_partition(c)
+
+    def test_lru_eviction_order_and_index_drop(self):
+        c = _cache(n_blocks=5)
+        c.reserve("a", 4)
+        _publish_all(c, "a", [1, 2, 3, 4])
+        c.free_seq("a")                         # block -> LRU
+        c.reserve("b", 4)
+        _publish_all(c, "b", [5, 6, 7, 8])
+        c.free_seq("b")
+        first, second = c.cached_blocks()
+        # Drain the LIFO tier; the next allocation must reclaim the
+        # LEAST recently freed cached block and unindex it.
+        c.reserve("z", 3 * 4)
+        t = c.reserve("y", 4)
+        assert t == [first] and c.lru_evicted_total == 1
+        assert c.match_prefix(_keys([1, 2, 3, 4])) == []
+        assert c.match_prefix(_keys([5, 6, 7, 8])) == [second]
+        check_partition(c)
+
+    def test_spec_rollback_on_forked_sequence_keeps_shared_prefix(self):
+        c = _cache()
+        toks = list(range(8))
+        c.reserve("a", 8)
+        _publish_all(c, "a", toks)
+        c.admit_shared("b", 8, _keys(toks))
+        shared = c.table("b")[:2]
+        c.spec_reserve("b", 14)                 # revocable extension
+        assert len(c.table("b")) == 4
+        c.commit("b", 9)                        # accept into block 2
+        freed = c.rollback("b")
+        assert freed == 1                       # the block above the cursor
+        assert c.table("b")[:2] == shared
+        assert all(c.ref(b) == 2 for b in shared), \
+            "rollback must never strand or release a shared block"
+        assert c.committed_len("b") == 9
+        check_partition(c)
+
+    def test_randomized_admit_fork_write_evict_interleave(self):
+        """Satellite pin: ≥300 randomized ops over a small pool —
+        refcounts + free tiers + tables partition the pool at EVERY
+        step, COW never hands out a shared block for writing, and spec
+        rollback on forked sequences never touches an adopted prefix."""
+        from tony_tpu.serve import AdmissionError
+
+        rng = np.random.RandomState(0)
+        c = _cache(n_blocks=16, block_size=4)
+        stems = [list(rng.randint(0, 50, 8)) for _ in range(3)]
+        seqs = {}                               # sid -> token list
+        sid_n = 0
+        for opno in range(340):
+            op = rng.choice(["admit", "write", "spec", "free"])
+            if op == "admit":
+                sid_n += 1
+                sid = f"s{sid_n}"
+                toks = list(stems[rng.randint(3)][:rng.choice([4, 8])]) \
+                    + list(rng.randint(0, 50, rng.randint(0, 6)))
+                try:
+                    c.admit_shared(sid, len(toks) + 4, _keys(toks))
+                except AdmissionError:
+                    check_partition(c)
+                    continue
+                seqs[sid] = toks
+                # Publish what a prefill would: every full prompt block.
+                _publish_all(c, sid, toks)
+            elif op == "write" and seqs:
+                sid = list(seqs)[rng.randint(len(seqs))]
+                span = len(c.table(sid)) * c.block_size
+                pos = rng.randint(span)
+                try:
+                    idx = c.write_index(sid, pos)
+                except AdmissionError:
+                    check_partition(c)
+                    continue
+                b = c.table(sid)[pos // c.block_size]
+                assert idx == b * c.block_size + pos % c.block_size
+                assert c.ref(b) == 1, \
+                    "a write target must be exclusively owned"
+            elif op == "spec" and seqs:
+                sid = list(seqs)[rng.randint(len(seqs))]
+                table_before = list(c.table(sid))
+                extent = len(table_before) * c.block_size
+                try:
+                    c.spec_reserve(sid, extent + rng.randint(1, 9))
+                except AdmissionError:
+                    check_partition(c)
+                    continue
+                accepted = rng.randint(extent + 1)
+                c.commit(sid, accepted)
+                c.rollback(sid)
+                assert c.table(sid)[:len(table_before)] == table_before, \
+                    "rollback must leave the pre-speculation table intact"
+            elif op == "free" and seqs:
+                sid = list(seqs)[rng.randint(len(seqs))]
+                del seqs[sid]
+                c.free_seq(sid)
+                assert c.free_seq(sid) == 0     # idempotent
+            check_partition(c)
+        assert c.adopted_total > 0 and c.cow_total > 0, \
+            "the interleave must actually exercise sharing and COW"
+        for sid in list(seqs):
+            c.free_seq(sid)
+        check_partition(c)
+        assert c.free_blocks == c.n_blocks
+
+
+# ---------------------------------------------------------------------------
+# Engine-level bitwise pins vs the unrouted PR 10 engine
+# ---------------------------------------------------------------------------
+
+class TestPrefixEngineBitwise:
+    def test_hit_and_miss_admissions_bitwise_vs_plain(self, tiny):
+        """Shared-prefix admissions (hits), unrelated admissions
+        (misses): token streams AND per-token logits identical to the
+        prefix-cache-off engine's."""
+        rng = np.random.RandomState(0)
+        shared = list(rng.randint(0, 256, 24))      # 3 full blocks of 8
+        prompts = [shared + list(rng.randint(0, 256, 5)),
+                   shared + list(rng.randint(0, 256, 9)),
+                   list(rng.randint(0, 256, 11)),   # miss
+                   shared[:8] + list(rng.randint(0, 256, 3))]
+        ref = run_requests(make_engine(tiny), prompts)
+        eng = make_engine(tiny, prefix_cache=True)
+        got = run_requests(eng, prompts)
+        assert_bitwise_equal(got, ref)
+        assert eng.prefix_hit_blocks > 0
+        assert eng.stats()["prefix_cache_hit_rate"] > 0
+        assert eng.cache.free_blocks == eng.cache.n_blocks
+
+    def test_cow_divergence_mid_block_and_at_boundary(self, tiny):
+        """The acceptance matrix: a follow-up prompt that diverges from
+        the cached conversation MID-block (the diverged block misses,
+        recompute from the boundary) and one that diverges exactly AT a
+        block boundary (maximal reuse), plus the full-cover repeat whose
+        tail re-computation COWs a live donor's block."""
+        rng = np.random.RandomState(1)
+        base = list(rng.randint(0, 256, 16))        # 2 full blocks
+        prompts = [base,
+                   base[:12] + list(rng.randint(0, 256, 7)),   # mid-block
+                   base[:8] + list(rng.randint(0, 256, 5)),    # boundary
+                   list(base)]                      # full-cover repeat
+        ref = run_requests(make_engine(tiny), prompts, max_new=5)
+        eng = make_engine(tiny, prefix_cache=True)
+        got = run_requests(eng, prompts, max_new=5)
+        assert_bitwise_equal(got, ref)
+        assert eng.cache.cow_total >= 1, \
+            "the full-cover repeat against a live donor must COW"
+        assert eng.cache.adopted_total >= 4
+
+    def test_recently_evicted_prefix_revives(self, tiny):
+        """Multi-turn after eviction: the first turn completes and
+        evicts; the follow-up prompt (history + new tokens) adopts the
+        cached-tier blocks — prefill rows drop, bits do not change."""
+        rng = np.random.RandomState(2)
+        turn1 = list(rng.randint(0, 256, 17))
+        eng = make_engine(tiny, prefix_cache=True)
+        first = run_requests(eng, [turn1], max_new=4)[0]
+        assert eng.cache.cached_blocks(), "evicted blocks must be cached"
+        turn2 = turn1 + first.tokens + list(rng.randint(0, 256, 4))
+        rows_before = eng.prefill_rows
+        got = run_requests(eng, [turn2], max_new=4)
+        assert eng.cache.revived_total > 0
+        # The adopted turn-1 blocks were not re-prefilled.
+        assert eng.prefill_rows - rows_before < -(-len(turn2) // 16) * 16
+        ref = run_requests(make_engine(tiny), [turn2], max_new=4)
+        assert_bitwise_equal(got, ref)
+
+    def test_spec_engine_rides_prefix_cache_bitwise(self, tiny):
+        """The speculative lane composes with sharing: forked sequences
+        verify through COW-aware writes and roll back without touching
+        the shared prefix; greedy outputs stay pinned to the plain
+        engine's."""
+        from tony_tpu.serve import Request, SpecEngine
+
+        model, params = tiny
+        rng = np.random.RandomState(3)
+        shared = list(rng.randint(0, 256, 16))
+        prompts = [shared + list(rng.randint(0, 256, n)) for n in (0, 3, 7)]
+        ref = run_requests(make_engine(tiny), prompts, max_new=6)
+        eng = SpecEngine(model, params, spec_k=4, ctx_max=64,
+                         block_size=8, q_block=16, decode_buckets=(2, 4),
+                         max_running=4, keep_logits=True,
+                         prefix_cache=True)
+        got = run_requests(eng, prompts, max_new=6)
+        assert_bitwise_equal(got, ref)
+        assert eng.cache.adopted_total > 0
+        assert eng.cache.free_blocks == eng.cache.n_blocks
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_chunked_vs_monolithic_bitwise_ragged(self, tiny):
+        """Ragged prompt lengths spanning the chunk boundary (chunk=16:
+        7/15/16/17/23) — chunked streams and logits are bit-identical
+        to monolithic prefill's."""
+        rng = np.random.RandomState(4)
+        prompts = [list(rng.randint(0, 256, n)) for n in (7, 15, 16, 17, 23)]
+        ref = run_requests(make_engine(tiny), prompts)
+        eng = make_engine(tiny, prefill_chunk=16)
+        got = run_requests(eng, prompts)
+        assert_bitwise_equal(got, ref)
+        assert eng.prefill_chunks >= 7      # 1+1+1+2+2 chunk launches
+        assert eng.stats()["prefill_chunks"] == float(eng.prefill_chunks)
+
+    def test_chunked_composes_with_prefix_cache(self, tiny):
+        rng = np.random.RandomState(5)
+        shared = list(rng.randint(0, 256, 24))
+        prompts = [shared + list(rng.randint(0, 256, n)) for n in (2, 6, 13)]
+        ref = run_requests(make_engine(tiny), prompts, max_new=3)
+        eng = make_engine(tiny, prefix_cache=True, prefill_chunk=16)
+        got = run_requests(eng, prompts, max_new=3)
+        assert_bitwise_equal(got, ref)
+        assert eng.prefix_hit_blocks > 0 and eng.prefill_chunks > 0
+
+    @pytest.mark.slow
+    def test_long_prompt_does_not_stall_decode(self, tiny):
+        """The latency property chunking buys: while a long prompt
+        prefills chunk by chunk, the already-running sequence keeps
+        emitting a token EVERY iteration — with monolithic prefill the
+        admission step stalls it for the whole prompt."""
+        from tony_tpu.serve import Request
+
+        eng = make_engine(tiny, ctx_max=128, prefill_chunk=16,
+                          keep_logits=False)
+        rng = np.random.RandomState(6)
+        eng.submit(Request(rid="short", tokens=[1, 2, 3],
+                           max_new_tokens=8))
+        eng.step()
+        long_prompt = list(rng.randint(0, 256, 60))   # 4 chunks
+        eng.submit(Request(rid="long", tokens=long_prompt,
+                           max_new_tokens=2))
+        grew = []
+        done = {}
+        for _ in range(4):
+            before = len(next(s for s in eng._running
+                              if s.rid == "short").tokens)
+            done.update({c.rid: c for c in eng.step()})
+            running = {s.rid: s for s in eng._running}
+            if "short" in running:
+                grew.append(len(running["short"].tokens) - before)
+        assert all(g == 1 for g in grew), \
+            f"decode stalled during chunked prefill: {grew}"
+        done.update({c.rid: c for c in eng.run()})
+        # Token-stream sanity against the monolithic engine.
+        mono = make_engine(tiny, ctx_max=128, keep_logits=False)
+        mono.submit(Request(rid="short", tokens=[1, 2, 3],
+                            max_new_tokens=8))
+        mono.step()
+        mono.submit(Request(rid="long", tokens=long_prompt,
+                            max_new_tokens=2))
+        mref = {c.rid: c for c in mono.run()}
+        assert done["short"].tokens == mref["short"].tokens
+        assert done["long"].tokens == mref["long"].tokens
+
+    def test_chunk_validation(self, tiny):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            make_engine(tiny, prefill_chunk=12)      # not a q_block multiple
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            make_engine(tiny, prefill_chunk=-16)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat/stats schema (satellite): engine -> stats file -> heartbeat
+# -> session -> router
+# ---------------------------------------------------------------------------
+
+class TestStatsSchema:
+    def test_stats_fields_present_and_zero_when_off(self, tiny):
+        from tony_tpu.serve import Request
+
+        eng = make_engine(tiny, keep_logits=False)
+        eng.submit(Request(rid="r", tokens=[1, 2, 3], max_new_tokens=2))
+        eng.run()
+        stats = eng.stats()
+        assert stats["prefix_cache_hit_rate"] == 0.0
+        assert stats["blocks_shared"] == 0.0
+        assert stats["prefill_chunks"] == 0.0
+        assert eng.prefix_digest() == []
+
+    def test_spec_engine_publishes_schema_zeros(self, tiny):
+        from tony_tpu.serve import Request, SpecEngine
+
+        model, params = tiny
+        eng = SpecEngine(model, params, spec_k=2, ctx_max=64,
+                         block_size=8, q_block=16, decode_buckets=(2,),
+                         max_running=2)
+        eng.submit(Request(rid="r", tokens=[1, 2, 3], max_new_tokens=3))
+        eng.run()
+        stats = eng.stats()
+        for key in ("prefix_cache_hit_rate", "blocks_shared",
+                    "prefill_chunks"):
+            assert stats[key] == 0.0
+
+    def test_stats_file_carries_digest_and_rpc_port(self, tiny, tmp_path):
+        from tony_tpu.executor import read_serve_stats
+        from tony_tpu.serve import Request, prefix
+
+        eng = make_engine(tiny, prefix_cache=True, keep_logits=False)
+        toks = list(np.random.RandomState(7).randint(0, 256, 19))
+        eng.submit(Request(rid="r", tokens=toks, max_new_tokens=3))
+        eng.run()
+        path = tmp_path / "serve-stats.json"
+        eng.write_stats(str(path), extra={"rpc_port": 4321})
+        read = read_serve_stats(path)
+        assert read["rpc_port"] == 4321.0
+        keys = prefix.chain_keys(toks, eng.block_size)
+        assert set(keys) <= set(read["prefix_digest"])
+        assert read["prefix_cache_hit_rate"] == 0.0
+
+    def test_executor_heartbeat_round_trips_new_schema(self, tmp_path):
+        """Stats file → heartbeat RPC → session.serve_metrics, with the
+        three new floats AND the digest list intact — the router's
+        whole input path."""
+        from tony_tpu import constants
+        from tony_tpu.conf import TonyConfig
+        from tony_tpu.executor import TaskExecutor
+        from tony_tpu.rpc import ApplicationRpcHandler, RpcServer
+        from tony_tpu.serve.router import RequestRouter
+        from tony_tpu.session import TonySession
+
+        conf = TonyConfig({"tony.serve.instances": "1",
+                           "tony.serve.command": "x"})
+        session = TonySession(conf, app_id="app_route_hb")
+        session.on_registered("serve", 0, "127.0.0.1", 4000)
+        server = RpcServer(ApplicationRpcHandler(session),
+                           host="127.0.0.1").start()
+        conf_path = tmp_path / "conf.json"
+        conf_path.write_text(json.dumps(dict(conf.items())))
+        payload = {"qps": 1.0, "p99_ms": 12.0, "queue_depth": 2.0,
+                   "prefix_cache_hit_rate": 0.75, "blocks_shared": 6.0,
+                   "prefill_chunks": 3.0, "rpc_port": 5555,
+                   "prefix_digest": ["aa", "bb"]}
+        try:
+            executor = TaskExecutor(env={
+                constants.ENV_JOB_NAME: "serve",
+                constants.ENV_TASK_INDEX: "0",
+                constants.ENV_AM_ADDRESS: server.address,
+                constants.ENV_CONF_PATH: str(conf_path),
+                constants.ENV_LOG_DIR: str(tmp_path),
+            })
+            executor.serve_stats_path().write_text(json.dumps(payload))
+            t = threading.Thread(target=executor._heartbeat_loop,
+                                 args=(0.05,), daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10.0
+            task = session.task("serve", 0)
+            while time.monotonic() < deadline and not task.serve_metrics:
+                time.sleep(0.05)
+            executor._hb_stop.set()
+            t.join(timeout=5)
+            got = task.serve_metrics
+            assert got["prefix_cache_hit_rate"] == 0.75
+            assert got["blocks_shared"] == 6.0
+            assert got["prefill_chunks"] == 3.0
+            assert got["prefix_digest"] == ["aa", "bb"]
+            assert got["rpc_port"] == 5555.0
+            # serve_endpoints exposes the routable wire form...
+            eps = session.serve_endpoints("serve")
+            assert len(eps) == 1 and eps[0]["host"] == "127.0.0.1"
+            # ...and the router ingests it end to end.
+            router = RequestRouter(block_size=8)
+            router.refresh_from_task_infos(eps)
+            views = router.replicas()
+            assert views[0].address == "127.0.0.1:5555"
+            assert views[0].digest == frozenset(["aa", "bb"])
+        finally:
+            server.stop()
+
+    def test_scaling_decide_unchanged_by_new_fields(self):
+        from tony_tpu.serve import scaling
+
+        pol = scaling.ScalingPolicy(min_replicas=1, max_replicas=4,
+                                    queue_high=8.0, queue_low=1.0)
+        hot = [{"queue_depth": 12.0, "p99_ms": 100.0,
+                "prefix_cache_hit_rate": 0.9, "blocks_shared": 50.0,
+                "prefill_chunks": 7.0, "prefix_digest": ["aa"]}]
+        assert scaling.decide(pol, 1, hot, now=0.0) == 1
+
+
+# ---------------------------------------------------------------------------
+# Router scoring / affinity / failover (pure + in-process)
+# ---------------------------------------------------------------------------
+
+class TestRouter:
+    def _keys(self, toks):
+        from tony_tpu.serve import prefix
+
+        return prefix.chain_keys(toks, 16)
+
+    def test_score_prefers_overlap_then_load(self):
+        from tony_tpu.serve.router import (ReplicaView, RouterPolicy,
+                                           score)
+
+        pol = RouterPolicy()
+        toks = list(range(48))
+        keys = self._keys(toks)
+        warm = ReplicaView(name="warm", address="x",
+                           digest=frozenset(keys))
+        cold = ReplicaView(name="cold", address="x")
+        busy = ReplicaView(name="busy", address="x",
+                           digest=frozenset(keys), queue_depth=16.0)
+        assert score(pol, warm, keys) > score(pol, cold, keys)
+        assert score(pol, cold, keys) > score(pol, busy, keys), \
+            "a deep queue must outweigh cache overlap"
+
+    def test_policy_validation(self):
+        from tony_tpu.serve.router import RouterPolicy
+
+        with pytest.raises(ValueError):
+            RouterPolicy(cache_weight=-1.0)
+
+    def test_sticky_affinity_and_retirement_failover(self):
+        from tony_tpu.serve.router import RequestRouter
+
+        calls = {"a": 0, "b": 0}
+
+        class Client:
+            def __init__(self, name):
+                self.name = name
+
+            def generate(self, tokens, max_new_tokens, rid=None):
+                calls[self.name] += 1
+                return {"rid": rid, "tokens": [0], "latency_ms": 1.0}
+
+        rt = RequestRouter(block_size=16)
+        rt.upsert_replica("a", client=Client("a"),
+                          stats={"queue_depth": 0.0})
+        rt.upsert_replica("b", client=Client("b"),
+                          stats={"queue_depth": 5.0})
+        first = rt.dispatch(list(range(16)), 2, session_id="s1")
+        assert first["replica"] == "a"          # lighter load wins
+        rt.upsert_replica("a", stats={"queue_depth": 50.0})
+        again = rt.dispatch(list(range(16)), 2, session_id="s1")
+        assert again["replica"] == "a", "affinity must out-pin load"
+        assert rt.affinity_hits == 1
+        rt.retire_replica("a")
+        moved = rt.dispatch(list(range(16)), 2, session_id="s1")
+        assert moved["replica"] == "b", "retirement must re-dispatch"
+        assert calls == {"a": 2, "b": 1}
+
+    def test_dead_replica_fails_over_and_revives_on_heartbeat(self):
+        from tony_tpu.serve.router import RequestRouter
+
+        class Dead:
+            def generate(self, *a, **k):
+                raise ConnectionError("gone")
+
+        class Live:
+            def generate(self, tokens, max_new_tokens, rid=None):
+                return {"rid": rid, "tokens": [0], "latency_ms": 1.0}
+
+        rt = RequestRouter(block_size=16)
+        rt.upsert_replica("x", client=Dead(), stats={"queue_depth": 0.0})
+        rt.upsert_replica("y", client=Live(), stats={"queue_depth": 1.0})
+        out = rt.dispatch(list(range(16)), 2, session_id="s")
+        assert out["replica"] == "y" and rt.failovers == 1
+        # A fresh heartbeat is the liveness source of truth.
+        rt.upsert_replica("x", stats={"queue_depth": 0.0})
+        assert rt.route(list(range(16))) == "x"
+
+    def test_no_replica_error(self):
+        from tony_tpu.serve.router import NoReplicaError, RequestRouter
+
+        rt = RequestRouter()
+        with pytest.raises(NoReplicaError):
+            rt.route([1, 2, 3])
+
+    def test_request_level_error_does_not_poison_fleet(self):
+        """A bad REQUEST (oversized prompt → AdmissionError) must
+        propagate to its caller, not mark healthy replicas down — one
+        misbehaving client must never render the fleet unroutable."""
+        from tony_tpu.serve import AdmissionError
+        from tony_tpu.serve.router import RequestRouter
+
+        class Healthy:
+            def generate(self, tokens, max_new_tokens, rid=None):
+                if len(tokens) > 4:
+                    raise AdmissionError("too big", retryable=False)
+                return {"rid": rid, "tokens": [0], "latency_ms": 1.0}
+
+        rt = RequestRouter(block_size=16)
+        rt.upsert_replica("a", client=Healthy(),
+                          stats={"queue_depth": 0.0})
+        with pytest.raises(AdmissionError):
+            rt.dispatch(list(range(10)), 2)
+        assert rt.failovers == 0
+        assert rt.replicas()[0].alive, \
+            "a request-level error must not down-mark the replica"
+        assert rt.dispatch([1, 2], 2)["tokens"] == [0]
+
+    def test_cache_aware_routing_wins_on_digest(self, tiny):
+        """In-process fleet: the replica that served the conversation
+        advertises its blocks; the router sends the follow-up there."""
+        from tony_tpu.serve import EngineFront
+        from tony_tpu.serve.router import RequestRouter
+
+        e1 = make_engine(tiny, prefix_cache=True, keep_logits=False)
+        e2 = make_engine(tiny, prefix_cache=True, keep_logits=False)
+        rt = RequestRouter(block_size=8)
+        rt.upsert_replica("r1", client=EngineFront(e1))
+        rt.upsert_replica("r2", client=EngineFront(e2))
+        rng = np.random.RandomState(8)
+        convo = list(rng.randint(0, 256, 17))
+        first = rt.dispatch(convo, 4)
+        served_by = first["replica"]
+        eng = e1 if served_by == "r1" else e2
+        # Heartbeat tick: each replica advertises queue + digest.
+        rt.upsert_replica("r1", stats={**e1.stats(),
+                                       "prefix_digest": e1.prefix_digest()})
+        rt.upsert_replica("r2", stats={**e2.stats(),
+                                       "prefix_digest": e2.prefix_digest()})
+        follow = convo + list(first["tokens"]) + [5, 6, 7]
+        assert rt.route(follow) == served_by, \
+            "overlap must route the follow-up to the warm replica"
+        assert rt.dispatch(follow, 2)["replica"] == served_by
+        assert rt.cache_routed >= 1
+
+
+# ---------------------------------------------------------------------------
+# Routed multi-replica serving vs one unrouted replica (the fleet pin)
+# ---------------------------------------------------------------------------
+
+class TestRoutedServing:
+    @pytest.mark.slow
+    def test_two_replica_routed_bitwise_vs_single(self, tiny):
+        """The acceptance pin: the SAME request set served through the
+        router over TWO replicas (sessions sticky, shared prefixes
+        cached) emits token streams identical to one unrouted PR 10
+        engine serving everything."""
+        from tony_tpu.serve import EngineFront
+        from tony_tpu.serve.router import RequestRouter
+
+        rng = np.random.RandomState(9)
+        stems = [list(rng.randint(0, 256, 16)) for _ in range(2)]
+        requests = []                           # (session, prompt, n)
+        for i in range(10):
+            stem = stems[i % 2]
+            requests.append((f"sess{i % 3}",
+                             stem + list(rng.randint(0, 256, 1 + i % 5)),
+                             3 + i % 3))
+        # Reference: one unrouted engine, sequential.
+        ref_eng = make_engine(tiny, max_running=8, keep_logits=False)
+        ref_front = EngineFront(ref_eng)
+        ref = [ref_front.generate(p, n).tokens
+               for (_, p, n) in requests]
+        # Fleet: two prefix-cache replicas behind the router.
+        e1 = make_engine(tiny, max_running=8, prefix_cache=True,
+                         keep_logits=False)
+        e2 = make_engine(tiny, max_running=8, prefix_cache=True,
+                         keep_logits=False)
+        rt = RequestRouter(block_size=8)
+        rt.upsert_replica("r1", client=EngineFront(e1))
+        rt.upsert_replica("r2", client=EngineFront(e2))
+        got = []
+        for sess, p, n in requests:
+            got.append(rt.dispatch(p, n, session_id=sess)["tokens"])
+            for name, e in (("r1", e1), ("r2", e2)):
+                rt.upsert_replica(name, stats={
+                    **e.stats(), "prefix_digest": e.prefix_digest()})
+        assert got == ref
+        assert e1.forwards > 0 and e2.forwards > 0, \
+            "the router must actually spread the fleet"
+        stats = rt.stats()
+        assert stats["dispatched"] == len(requests)
+
+    @pytest.mark.slow
+    def test_router_server_over_rpc_with_failover(self, tiny):
+        """The network front: two RPC replicas behind a RouterServer;
+        killing one mid-trace re-dispatches without losing a request."""
+        from tony_tpu.rpc import RpcClient, RpcServer
+        from tony_tpu.serve import EngineFront
+        from tony_tpu.serve.router import RequestRouter, RouterServer
+
+        class Handler:
+            def __init__(self, front):
+                self.front = front
+
+            def rpc_generate(self, tokens, max_new_tokens=16, rid=None):
+                c = self.front.generate(tokens, max_new_tokens, rid=rid)
+                return {"rid": c.rid, "tokens": c.tokens,
+                        "latency_ms": round(1e3 * c.latency_s, 3)}
+
+        e1 = make_engine(tiny, keep_logits=False)
+        e2 = make_engine(tiny, keep_logits=False)
+        f1, f2 = EngineFront(e1), EngineFront(e2)
+        # Warm the jit shapes OUTSIDE the RPC window: the client's
+        # per-op socket cap (10 s) is for transport, not CPU compiles.
+        f1.generate([7, 7], 3)
+        f2.generate([7, 7], 3)
+        s1 = RpcServer(Handler(f1), host="127.0.0.1").start()
+        s2 = RpcServer(Handler(f2), host="127.0.0.1").start()
+        router = RequestRouter(block_size=8, dial_timeout_s=2.0)
+        router.upsert_replica("r1", address=f"127.0.0.1:{s1.port}",
+                              stats={"queue_depth": 0.0})
+        router.upsert_replica("r2", address=f"127.0.0.1:{s2.port}",
+                              stats={"queue_depth": 1.0})
+        try:
+            with RouterServer(router, host="127.0.0.1") as front:
+                with RpcClient(front.address, timeout=120.0) as client:
+                    out = client.call("generate", tokens=[1, 2, 3, 4],
+                                      max_new_tokens=3,
+                                      session_id="sess")
+                    assert out["replica"] == "r1"
+                    ref = out["tokens"]
+                    s1.stop()               # the pinned replica dies
+                    out2 = client.call("generate", tokens=[1, 2, 3, 4],
+                                       max_new_tokens=3,
+                                       session_id="sess")
+                    assert out2["replica"] == "r2"
+                    assert out2["tokens"] == ref, \
+                        "failover must reproduce the greedy stream"
+                    stats = client.call("router_stats")
+                    assert stats["failovers"] >= 1
+        finally:
+            s2.stop()
+
+    def test_cli_route_parser_and_serve_flags(self, tmp_path):
+        from tony_tpu.cli import make_parser
+
+        args = make_parser().parse_args([
+            "route", "--am", "127.0.0.1:9999", "--block_size", "8"])
+        assert args.fn.__name__ == "cmd_route"
+        assert args.cache_weight == 4.0
+        sv = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--ckpt_dir",
+            str(tmp_path), "--prefix_cache", "--prefill_chunk", "32"])
+        assert sv.prefix_cache and sv.prefill_chunk == 32
+        from tony_tpu.cli import cmd_serve
+
+        bad = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--ckpt_dir",
+            str(tmp_path), "--prefill_chunk", "12"])
+        with pytest.raises(SystemExit, match="prefill_chunk"):
+            cmd_serve(bad)
+
+
+# ---------------------------------------------------------------------------
+# The eighth analyze config
+# ---------------------------------------------------------------------------
+
+class TestAnalyzeRoute:
+    def test_analyze_route_config_clean_with_pin(self):
+        """The acceptance gate: `tony analyze --config route` is clean
+        with zero waivers against the committed pin — chunked prefill
+        introduces no compiled step shape beyond the declared chunk
+        geometry, with zero inter-chip collectives and donated KV
+        pools (also covered by the test_analysis parametrization; this
+        is the route lane's named copy)."""
+        from tony_tpu.analysis import cli as acli
+
+        report = acli.run_config(
+            "route", signature_path=str(
+                Path(__file__).parent / "signatures" / "route.json"))
+        assert report.ok, report.summary()
+        assert not report.waived
+        assert report.signature["collectives"] == {}
+        assert report.config["prefill_chunk"] == 32

@@ -572,11 +572,13 @@ class TestAnalyzeSpec:
         assert report.config["draft"] == "ngram"
 
     def test_unknown_step_rejected(self, tiny):
+        # "prefill" joined the step family in PR 13 (the route config);
+        # the reject path needs a genuinely unknown name.
         from tony_tpu import analysis
 
         eng = make_spec(tiny, spec_k=2)
         with pytest.raises(ValueError, match="unknown serve step"):
-            analysis.analyze_serve_step(eng, step="prefill")
+            analysis.analyze_serve_step(eng, step="sample")
 
 
 # ---------------------------------------------------------------------------

@@ -163,13 +163,24 @@ def analyze_serve_step(engine: Any, *, waivers: Sequence[Waiver] = (),
     one-launch k-token verification through its ``verify_traced`` hook
     instead — the same rule suite over the speculative lane's hot path
     (zero collectives on a replica mesh, KV-pool donation, pinned
-    signature), with the spec geometry in the report config."""
+    signature), with the spec geometry in the report config.
+
+    ``step="prefill"`` audits the chunked-prefill launch through
+    ``prefill_traced`` — the ``(1, prefill_chunk)`` shape every
+    non-final chunk rides. The ``route`` config pins it: chunked
+    prefill must introduce no compiled step shape beyond the declared
+    chunk geometry, and that program must satisfy the identical
+    replica-step invariants (zero inter-chip collectives, donated KV
+    pools)."""
     if step == "verify":
         jitted, args = engine.verify_traced(batch)
+    elif step == "prefill":
+        jitted, args = engine.prefill_traced()
     elif step == "decode":
         jitted, args = engine.decode_traced(batch)
     else:
-        raise ValueError(f"unknown serve step {step!r} (decode|verify)")
+        raise ValueError(f"unknown serve step {step!r} "
+                         f"(decode|verify|prefill)")
     traced = jitted.trace(*args)
     closed = traced.jaxpr
     donate_argnums = tuple(getattr(traced, "donate_argnums", ()) or ())
@@ -223,6 +234,9 @@ def analyze_serve_step(engine: Any, *, waivers: Sequence[Waiver] = (),
     if step == "verify":
         config["spec_k"] = int(engine.spec_k)
         config["draft"] = getattr(engine.draft, "kind", "?")
+    if step == "prefill":
+        config["prefill_chunk"] = engine.prefill_chunk
+        config["prefix_cache"] = bool(engine.prefix_cache)
     report = AnalysisReport(
         tag=tag, findings=tuple(active), waived=tuple(waived),
         collectives=tuple(colls), signature=sig, config=config)

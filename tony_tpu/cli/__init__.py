@@ -119,6 +119,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
             raise SystemExit(f"{flag} needs --draft_model (without one "
                              f"the replica runs the n-gram self-draft "
                              f"and the flag would be silently ignored)")
+    # Prefix caching / chunked prefill (tony_tpu.serve PR 13): validate
+    # the chunk geometry at submit — the engine would reject a
+    # non-row-block multiple at launch, replica by replica.
+    if args.prefill_chunk and (args.prefill_chunk <= 0
+                               or args.prefill_chunk % 16):
+        raise SystemExit(f"--prefill_chunk must be a positive multiple "
+                         f"of the 16-row block, got {args.prefill_chunk}")
+    if args.prefix_cache:
+        cfg.set(conf_mod.SERVE_PREFIX_CACHE, "true")
+    if args.prefill_chunk:
+        cfg.set(conf_mod.SERVE_PREFILL_CHUNK, str(args.prefill_chunk))
     if args.spec_k:
         cfg.set(conf_mod.SERVE_SPEC_K, str(args.spec_k))
     if args.draft_model:
@@ -137,6 +148,36 @@ def cmd_serve(args: argparse.Namespace) -> int:
     client = TonyClient(cfg, workdir=args.workdir, am_host=args.am_host,
                         quiet=args.quiet)
     return client.run(timeout=args.timeout)
+
+
+def cmd_route(args: argparse.Namespace) -> int:
+    """Run the fleet's request router (tony_tpu.serve.router): a
+    gateway-side RPC front that polls the AM's ``serve_endpoints`` verb
+    for the live replica set and dispatches ``generate`` calls by
+    prefix-cache overlap, queue depth, and p99 — with sticky session
+    affinity and failover re-dispatch. Jax-free: runs on any gateway
+    host."""
+    import threading
+
+    from tony_tpu.serve.router import (RequestRouter, RouterPolicy,
+                                       RouterServer)
+
+    policy = RouterPolicy(cache_weight=args.cache_weight,
+                          queue_weight=args.queue_weight,
+                          p99_weight=args.p99_weight)
+    router = RequestRouter(block_size=args.block_size, policy=policy)
+    server = RouterServer(router, port=args.port, am_address=args.am,
+                          poll_s=args.poll_s)
+    server.start()
+    print(f"[tony-route] listening on {server.address}, tracking "
+          f"replicas via AM {args.am}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
 
 
 def cmd_history(args: argparse.Namespace) -> int:
@@ -345,6 +386,14 @@ def make_parser() -> argparse.ArgumentParser:
                     help="max positions per sequence (KV buffer extent)")
     sv.add_argument("--mesh", help="JSON MeshSpec kwargs for each "
                     "replica's own mesh (e.g. '{\"fsdp\": 2}')")
+    sv.add_argument("--prefix_cache", action="store_true",
+                    help="arm block-level KV prefix sharing: admissions "
+                         "whose prompt chain-matches cached blocks skip "
+                         "that prefill outright (bitwise transparent)")
+    sv.add_argument("--prefill_chunk", type=int, default=0,
+                    help="chunked prefill rows per iteration (a 16-row "
+                         "block multiple; 0 = monolithic): long prompts "
+                         "interleave with decode instead of stalling it")
     sv.add_argument("--spec_k", type=int, default=0,
                     help="speculative decoding draft depth (0 = off; "
                          "k tokens drafted, verified in ONE target "
@@ -365,6 +414,25 @@ def make_parser() -> argparse.ArgumentParser:
     sv.add_argument("--timeout", type=float, default=None)
     sv.add_argument("--quiet", action="store_true")
     sv.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser("route", help="run the fleet request router: "
+                        "routes generate RPCs over the live replica set "
+                        "by prefix-cache overlap and load")
+    rt.add_argument("--am", required=True,
+                    help="AM RPC address (host:port) to poll for the "
+                         "live replica set")
+    rt.add_argument("--port", type=int, default=0,
+                    help="router RPC port (0 = any)")
+    rt.add_argument("--block_size", type=int, default=16,
+                    help="fleet KV block size (must match the replicas' "
+                         "engine geometry — the chain keys are "
+                         "block-aligned)")
+    rt.add_argument("--cache_weight", type=float, default=4.0)
+    rt.add_argument("--queue_weight", type=float, default=1.0)
+    rt.add_argument("--p99_weight", type=float, default=0.5)
+    rt.add_argument("--poll_s", type=float, default=2.0,
+                    help="AM membership poll interval")
+    rt.set_defaults(fn=cmd_route)
 
     h = sub.add_parser("history", help="list jobs or show one job's events")
     h.add_argument("action", choices=["list", "show", "serve"],

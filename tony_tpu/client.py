@@ -243,14 +243,23 @@ class TonyClient:
     def _print_transitions(self, infos: List[Dict]) -> None:
         for info in infos:
             tid = f"{info['job_type']}:{info['index']}"
-            status = info["status"]
-            if self._last_status.get(tid) != status:
-                self._last_status[tid] = status
+            # The poll is sampled, so a fast worker can pass through
+            # RUNNING between two polls — walk the AM's status history
+            # (to_info carries it) and print every transition not yet
+            # logged, in order, instead of only the latest snapshot.
+            # Older AMs (no history) degrade to the snapshot alone.
+            history = info.get("status_history") or [info["status"]]
+            statuses = [s for s in history if s != "NEW"]
+            printed = self._last_status.get(tid, [])
+            if statuses[:len(printed)] != printed:
+                printed = []          # a new AM attempt restarted the task
+            for status in statuses[len(printed):]:
                 where = f" on {info['host']}" if info.get("host") else ""
                 extra = ""
                 if status in ("FAILED", "LOST") and info.get("diagnostics"):
                     extra = f" — {info['diagnostics']}"
                 self._log(f"task {tid} -> {status}{where}{extra}")
+            self._last_status[tid] = statuses
 
     def monitor(self, timeout: Optional[float] = None) -> int:
         """Poll until the job reaches a final status; returns the exit code

@@ -126,6 +126,15 @@ SERVE_COOLDOWN_S = "tony.serve.scale.cooldown-s"
 # transformer through the same elastic-restore path; without one the
 # self-drafting n-gram fallback runs — no second checkpoint needed.
 SERVE_SPEC_K = "tony.serve.spec-k"              # draft depth (0 = off)
+# Prefix caching + chunked prefill + cross-replica routing (PR 13): the
+# engine's prefix tier shares block-hashed KV across admissions; chunked
+# prefill interleaves long prompts with decode; the route weights feed
+# the gateway router's replica scoring (prefix-digest overlap vs load).
+SERVE_PREFIX_CACHE = "tony.serve.prefix-cache"  # true arms block sharing
+SERVE_PREFILL_CHUNK = "tony.serve.prefill-chunk"  # rows/chunk (0 = mono)
+SERVE_ROUTE_CACHE_WEIGHT = "tony.serve.route.cache-weight"
+SERVE_ROUTE_QUEUE_WEIGHT = "tony.serve.route.queue-weight"
+SERVE_ROUTE_P99_WEIGHT = "tony.serve.route.p99-weight"
 SERVE_DRAFT_MODEL = "tony.serve.draft.model"    # registered draft model
 SERVE_DRAFT_MODEL_KWARGS = "tony.serve.draft.model-kwargs"  # JSON kwargs
 SERVE_DRAFT_CKPT_DIR = "tony.serve.draft.ckpt-dir"  # draft training ckpt

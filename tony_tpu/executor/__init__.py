@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 
 from tony_tpu import constants
 from tony_tpu import conf as conf_mod
+from tony_tpu import util
 from tony_tpu.conf import TonyConfig
 from tony_tpu.rpc import ENV_JOB_TOKEN, RpcClient
 from tony_tpu.runtime import TaskContext, get_framework
@@ -82,15 +83,17 @@ def _link_tree(src: Path, dest: Path, symlinks: bool = False) -> None:
     shutil.copytree(src, dest, symlinks=symlinks, copy_function=_link)
 
 
-def read_serve_stats(path: str | Path) -> Optional[Dict[str, float]]:
+def read_serve_stats(path: str | Path) -> Optional[Dict[str, object]]:
     """The replica engine's published telemetry (qps/p99_ms/queue_depth
-    — see ``ServeEngine.write_stats``), or None. Jax-free and
-    failure-silent by contract: this rides the heartbeat loop, and a
-    torn/absent/garbage stats file must never sink liveness."""
+    — see ``ServeEngine.write_stats``), or None. Scalars normalize to
+    float; the router's ``prefix_digest`` (a list of block chain-keys)
+    passes through as a string list. Jax-free and failure-silent by
+    contract: this rides the heartbeat loop, and a torn/absent/garbage
+    stats file must never sink liveness."""
     try:
         with open(path) as fh:
             raw = json.load(fh)
-        return {str(k): float(v) for k, v in dict(raw).items()}
+        return util.normalize_serve_telemetry(raw)
     except Exception:   # noqa: BLE001 — advisory telemetry only
         return None
 

@@ -337,6 +337,14 @@ class ApplicationRpcHandler:
     def rpc_get_task_infos(self) -> list:
         return self.session.task_infos()
 
+    def rpc_serve_endpoints(self, job_type: str = "serve") -> list:
+        """The routable replica set (tony_tpu.serve.router): serve
+        tasks with reported telemetry, in task_infos wire form — the
+        router derives each live replica's dial address from
+        ``host`` + the heartbeat-carried ``rpc_port`` and retires
+        terminal entries."""
+        return self.session.serve_endpoints(job_type)
+
     def rpc_get_task_callback_info(self) -> Dict[str, str]:
         """The per-task pushed callback payloads (e.g. profiler endpoints) —
         consumed by ``tony profile`` to find live trace servers."""

@@ -28,7 +28,17 @@ the execution loop watches every user rebuild it badly):
   proposes k tokens and the target verifies all k+1 positions in ONE
   forward through the same ``q_block`` row-block step the decode loop
   runs — greedy-path token streams and logits stay BITWISE identical to
-  the non-speculative engine while tokens-per-forward multiplies.
+  the non-speculative engine while tokens-per-forward multiplies;
+* :mod:`~tony_tpu.serve.prefix` — block-level chain hashing (jax-free):
+  the content-address scheme the pool's prefix tier and the router's
+  overlap scoring share, so a replica and the gateway derive identical
+  keys from identical tokens;
+* :mod:`~tony_tpu.serve.router` — the cross-replica request router
+  (jax-free): scores the elastic replica set by prefix-cache overlap
+  (block digests carried on the heartbeat), queue depth, and p99, with
+  sticky session affinity for multi-turn traffic and failover
+  re-dispatch on replica retirement — the fleet, not a replica, is the
+  unit of throughput.
 
 Numerics contract: continuous-batching decode is BIT-identical to a
 sequential full prefill of the same tokens — every op in the serve
@@ -40,9 +50,11 @@ logits. ``tests/test_serve.py`` pins this end to end.
 
 from typing import Any
 
-__all__ = ["AdmissionError", "Completion", "ModelDraft", "NgramDraft",
-           "PagedKVCache", "Request", "ServeEngine", "SpecEngine",
-           "engine", "kvcache", "replica", "scaling", "spec"]
+__all__ = ["AdmissionError", "Completion", "EngineFront", "ModelDraft",
+           "NgramDraft", "NoReplicaError", "PagedKVCache", "Request",
+           "RequestRouter", "RouterPolicy", "RouterServer", "ServeEngine",
+           "SpecEngine", "engine", "kvcache", "prefix", "replica",
+           "router", "scaling", "spec"]
 
 # LAZY facade (PEP 562, like tony_tpu.analysis): the engine pulls jax,
 # but the AM's autoscaler only needs the pure scaling policy and the
@@ -53,9 +65,12 @@ __all__ = ["AdmissionError", "Completion", "ModelDraft", "NgramDraft",
 _LAZY = {
     "AdmissionError": "kvcache", "PagedKVCache": "kvcache",
     "Completion": "engine", "Request": "engine", "ServeEngine": "engine",
+    "EngineFront": "engine",
     "ModelDraft": "spec", "NgramDraft": "spec", "SpecEngine": "spec",
-    "engine": None, "kvcache": None, "replica": None, "scaling": None,
-    "spec": None,
+    "NoReplicaError": "router", "RequestRouter": "router",
+    "RouterPolicy": "router", "RouterServer": "router",
+    "engine": None, "kvcache": None, "prefix": None, "replica": None,
+    "router": None, "scaling": None, "spec": None,
 }
 
 

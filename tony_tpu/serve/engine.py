@@ -28,6 +28,24 @@ Static shapes are bucketed so join/evict never recompiles:
   lengths never change a shape (masking by absolute position does the
   rest).
 
+Two admission-path features ride those shapes since PR 13, both OFF by
+default so the unrouted engine is byte-for-byte the PR 10/12 one:
+
+* ``prefix_cache=True`` — prompts chain-hash per full KV block and
+  adopt published pool blocks (:mod:`tony_tpu.serve.kvcache`'s prefix
+  tier) instead of recomputing the shared prefix: the corresponding
+  prefill launches are simply never issued. Bitwise transparent — an
+  adopted block holds exactly the bytes the skipped launch would have
+  written (row independence at tile multiples), and every KV scatter
+  goes through the cache's copy-on-write ``write_index`` so a shared
+  block is never mutated;
+* ``prefill_chunk=N`` — prompts prefill in fixed ``N``-row chunks (a
+  ``q_block`` multiple), one chunk per engine iteration, interleaved
+  with decode: a long admission costs the running batch one extra
+  launch per token step instead of a whole-prompt stall. The chunk
+  geometry is the only new compiled shape, pinned by the ``route``
+  analyze signature.
+
 The decode step is registered with the collective planner at build time
 (:func:`tony_tpu.profiler.record_collective`, plane ``serve_decode``)
 with an EMPTY expected set: a replica's decode touches no inter-chip
@@ -53,6 +71,7 @@ import numpy as np
 
 from tony_tpu._trace import trace_record
 from tony_tpu.compat import mesh_context
+from tony_tpu.serve import prefix as prefix_mod
 from tony_tpu.serve.kvcache import AdmissionError, PagedKVCache
 
 _record = functools.partial(trace_record, "serve")
@@ -82,7 +101,7 @@ class Completion:
 
 class _Seq:
     __slots__ = ("rid", "tokens", "n_prompt", "remaining", "logits",
-                 "t_submit")
+                 "t_submit", "pf_pos", "published", "hkey")
 
     def __init__(self, req: Request, t_submit: float):
         self.rid = req.rid
@@ -91,6 +110,15 @@ class _Seq:
         self.remaining = int(req.max_new_tokens)
         self.logits: List[np.ndarray] = []
         self.t_submit = t_submit
+        # Prefill cursor: the next position whose row is still
+        # uncomputed (admission sets it past an adopted shared prefix;
+        # chunked prefill advances it chunk by chunk).
+        self.pf_pos = 0
+        # Prefix-publication cursor: blocks [0, published) are indexed
+        # under their chain keys; ``hkey`` is the chain state (the last
+        # published block's key) so extension never rehashes history.
+        self.published = 0
+        self.hkey = ""
 
 
 def _bucket_of(buckets: Sequence[int], n: int) -> int:
@@ -226,7 +254,9 @@ class ServeEngine(PagedModelRunner):
                  q_block: int = 16, decode_buckets: Sequence[int] = (4, 16),
                  max_running: int = 16, mesh: Optional[Any] = None,
                  keep_logits: bool = False, join_policy: str = "continuous",
-                 stats_window_s: float = 60.0, tag: str = "serve"):
+                 stats_window_s: float = 60.0, tag: str = "serve",
+                 prefix_cache: bool = False,
+                 prefill_chunk: Optional[int] = None):
         if join_policy not in ("continuous", "static"):
             raise ValueError(f"unknown join_policy {join_policy!r} "
                              "(continuous|static)")
@@ -235,12 +265,38 @@ class ServeEngine(PagedModelRunner):
                          decode_buckets=decode_buckets,
                          max_running=max_running, n_blocks=n_blocks,
                          mesh=mesh)
+        # Prefix caching (off by default — the unrouted PR 10/12
+        # behavior): admission chain-hashes the prompt's full blocks and
+        # adopts published matches instead of recomputing them. Bitwise
+        # transparent by the row-independence contract; the route tests
+        # pin hit and miss against this engine with the knob off.
+        self.prefix_cache = bool(prefix_cache)
+        # Chunked prefill (None = monolithic): long prompts prefill in
+        # fixed row-block-multiple chunks interleaved with decode
+        # iterations, so one long admission never stalls every running
+        # sequence's next token for a whole-prompt launch.
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk <= 0 or prefill_chunk % self.q_block:
+                raise ValueError(
+                    f"prefill_chunk must be a positive q_block="
+                    f"{self.q_block} multiple, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         self.keep_logits = keep_logits
         self.join_policy = join_policy
         self.tag = tag
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._running: List[_Seq] = []
+        self._prefilling: List[_Seq] = []
+        # Prefix/prefill telemetry (lifetime counters; the heartbeat
+        # schema publishes the derived rate — zeros when the features
+        # are off, so the fleet schema stays uniform).
+        self.prefix_lookup_blocks = 0
+        self.prefix_hit_blocks = 0
+        self.prefill_launches = 0
+        self.prefill_rows = 0
+        self.prefill_chunks = 0
         # Telemetry: completion ring for p50/p99, monotonic counters for
         # rates — O(1) per step, million-request safe.
         # (t_done, latency_s, n_tokens) per completion: rates and
@@ -272,7 +328,9 @@ class ServeEngine(PagedModelRunner):
                 n_blocks=self.cache.n_blocks, q_block=self.q_block,
                 decode_buckets=list(self.decode_buckets),
                 max_running=self.max_running,
-                join_policy=self.join_policy)
+                join_policy=self.join_policy,
+                prefix_cache=self.prefix_cache,
+                prefill_chunk=self.prefill_chunk)
 
     def expected_collectives(self) -> list:
         """The planner-registered expected collective set of the decode
@@ -312,23 +370,87 @@ class ServeEngine(PagedModelRunner):
 
     @property
     def running(self) -> int:
-        return len(self._running)
+        # Chunk-prefilling sequences hold pool blocks and engine work —
+        # they are in-flight for every queue/occupancy consumer.
+        return len(self._running) + len(self._prefilling)
 
     # -- prefill -----------------------------------------------------------
-    def _prefill(self, seq: _Seq) -> None:
-        t_real = len(seq.tokens)
-        t_pad = -(-t_real // self.q_block) * self.q_block
+    def _prefill_span(self, seq: _Seq, c1: int, t_pad: int) -> None:
+        """One prefill launch over positions ``[seq.pf_pos, c1)`` padded
+        to ``t_pad`` rows — the whole remaining prompt (monolithic), one
+        chunk (chunked), or the tail re-computation after a full prefix
+        hit. Rows attend to earlier positions through the pool gather
+        and to each other through the forward's in-buffer scatter, so
+        the split point cannot change a bit (the route tests pin chunked
+        vs monolithic). Emits the first token when ``c1`` completes the
+        prompt."""
+        c0 = seq.pf_pos
+        t_real = c1 - c0
+        n = len(seq.tokens)
         tokens = np.zeros((1, t_pad), np.int32)
-        tokens[0, :t_real] = seq.tokens
-        positions = np.broadcast_to(
-            np.arange(t_pad, dtype=np.int32)[None], (1, t_pad)).copy()
-        tables = self.cache.table_array([seq.rid], self.nb_max)
+        tokens[0, :t_real] = seq.tokens[c0:c1]
+        positions = (c0 + np.arange(t_pad, dtype=np.int32))[None].copy()
         flat = np.full((1, t_pad), self.cache.oob_index, np.int32)
-        for p in range(t_real):
-            flat[0, p] = self.cache.flat_index(seq.rid, p)
+        for j in range(t_real):
+            # write_index, not flat_index: a fully-matched admission's
+            # tail row lands in an adopted block — the writer must own a
+            # private copy first (COW; pre-copied at admission).
+            flat[0, j] = self.cache.write_index(seq.rid, c0 + j)
+        tables = self.cache.table_array([seq.rid], self.nb_max)
         logits = self._run_fn(1, t_pad, tokens, positions, tables, flat)
-        last = np.asarray(logits[0, t_real - 1], np.float32)
-        self._emit_token(seq, last)
+        self.prefill_launches += 1
+        self.prefill_rows += t_pad
+        seq.pf_pos = c1
+        if c1 >= n:
+            last = np.asarray(logits[0, n - 1 - c0], np.float32)
+            self._emit_token(seq, last)
+        else:
+            self._publish(seq)
+
+    def _prefill(self, seq: _Seq) -> None:
+        """Monolithic prefill of everything past the prefill cursor."""
+        t_real = len(seq.tokens) - seq.pf_pos
+        t_pad = -(-t_real // self.q_block) * self.q_block
+        self._prefill_span(seq, len(seq.tokens), t_pad)
+
+    def _prefill_chunk_step(self, seq: _Seq) -> bool:
+        """Advance one chunk; True when the prompt completed (and the
+        first token was emitted). Non-final chunks launch at the fixed
+        ``(1, prefill_chunk)`` shape; the final chunk pads its remainder
+        to a row-block multiple — the whole declared chunk geometry the
+        ``route`` analyze signature pins."""
+        n = len(seq.tokens)
+        c1 = min(n, seq.pf_pos + self.prefill_chunk)
+        t_real = c1 - seq.pf_pos
+        t_pad = (self.prefill_chunk if c1 < n
+                 else -(-t_real // self.q_block) * self.q_block)
+        self._prefill_span(seq, c1, t_pad)
+        self.prefill_chunks += 1
+        return seq.pf_pos >= n
+
+    # -- prefix publication ------------------------------------------------
+    def _publish(self, seq: _Seq) -> None:
+        """Index every newly-completed block under its chain key. The
+        publishable extent is ``len(tokens) - 1``: rows strictly below
+        it are verified-written on every path (after prefill+emit, after
+        a decode emit, and after a verify round's commit — the spec
+        engine's accepted rows were computed from true tokens), so a
+        published block can never leak a draft byte."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        # Written extent: the prefill cursor until the prompt is done,
+        # then every row below the newest token (each decode/verify
+        # feeds and writes the row below the token it emits).
+        limit = (len(seq.tokens) - 1 if seq.pf_pos >= seq.n_prompt
+                 else seq.pf_pos)
+        while (seq.published + 1) * bs <= limit:
+            i = seq.published
+            key = prefix_mod.chain_keys(
+                seq.tokens[i * bs:(i + 1) * bs], bs, prior=seq.hkey)[0]
+            self.cache.publish_block(seq.rid, i, key)
+            seq.hkey = key
+            seq.published += 1
 
     # -- decode ------------------------------------------------------------
     def _decode(self) -> None:
@@ -339,13 +461,15 @@ class ServeEngine(PagedModelRunner):
         positions = np.zeros((b, t), np.int32)
         tables = np.zeros((b, self.nb_max), np.int32)
         flat = np.full((b, t), self.cache.oob_index, np.int32)
-        tables[:len(seqs)] = self.cache.table_array(
-            [s.rid for s in seqs], self.nb_max)
         for i, s in enumerate(seqs):
             p0 = len(s.tokens) - 1          # the newest, not-yet-fed token
             tokens[i, 0] = s.tokens[-1]
             positions[i] = p0 + np.arange(t, dtype=np.int32)
-            flat[i, 0] = self.cache.flat_index(s.rid, p0)
+            flat[i, 0] = self.cache.write_index(s.rid, p0)
+        # Tables AFTER the write-index pass: write_index may COW-repoint
+        # a table slot, and the gather must see the repointed table.
+        tables[:len(seqs)] = self.cache.table_array(
+            [s.rid for s in seqs], self.nb_max)
         logits = self._run_fn(b, t, tokens, positions, tables, flat)
         rows = np.asarray(logits[:len(seqs), 0], np.float32)
         for i, s in enumerate(seqs):
@@ -357,24 +481,85 @@ class ServeEngine(PagedModelRunner):
         seq.tokens.append(int(np.argmax(row)))   # greedy: deterministic
         seq.remaining -= 1
         self._emitted += 1
+        self._publish(seq)
 
     # -- scheduling --------------------------------------------------------
+    def _admit(self, req: Request) -> Tuple[int, int, Sequence[str]]:
+        """Reserve the request's full extent, adopting any published
+        prefix blocks first; returns ``(start, matched, keys)`` — the
+        prefill start position (past the adopted extent: those launches
+        are simply never issued), the adopted block count, and the
+        prompt's chain keys (so publication seeding never rehashes
+        them). Raises :class:`AdmissionError` with the cache unchanged
+        on pool pressure, so a queued request retries whole."""
+        total = len(req.tokens) + req.max_new_tokens
+        if not self.prefix_cache:
+            self.cache.reserve(req.rid, total)
+            return 0, 0, ()
+        keys = prefix_mod.chain_keys(req.tokens, self.block_size)
+        matched = self.cache.admit_shared(req.rid, total, keys)
+        m = matched * self.block_size
+        if m >= len(req.tokens):
+            # Full cover: the last prompt row still re-computes (its
+            # logits seed generation), and its KV write lands in an
+            # adopted block — take the private copy NOW, inside the
+            # admission transaction, so the one COW this sequence can
+            # ever need cannot fail mid-flight. If even that one spare
+            # block can't be supplied, DEGRADE the match by the tail
+            # block (its rows compute fresh into the reservation's own
+            # blocks — no COW needed) rather than queue-spinning a
+            # request the capacity check already accepted.
+            try:
+                self.cache.cow_block(req.rid,
+                                     (len(req.tokens) - 1)
+                                     // self.block_size)
+            except AdmissionError:
+                self.cache.free_seq(req.rid)
+                matched = self.cache.admit_shared(req.rid, total,
+                                                  keys[:-1])
+                m = matched * self.block_size
+        # Counters only after the admission definitively succeeded —
+        # a pressure-retried request must not skew the published
+        # prefix_cache_hit_rate with every retry.
+        self.prefix_lookup_blocks += len(keys)
+        self.prefix_hit_blocks += matched
+        return min(m, len(req.tokens) - 1), matched, keys
+
+    def _seed_publication(self, seq: _Seq, matched: int,
+                          keys: Sequence[str]) -> None:
+        """An adopted prefix is already indexed — advance the
+        publication cursor past it so the sequence publishes only what
+        it computes (``keys`` are the admission's chain keys; no
+        rehash)."""
+        if matched:
+            seq.published = matched
+            seq.hkey = keys[matched - 1]
+
     def _join(self, results: List[Completion]) -> None:
-        if self.join_policy == "static" and self._running:
+        if self.join_policy == "static" and (self._running
+                                             or self._prefilling):
             return
-        while len(self._running) < self.max_running:
+        while len(self._running) + len(self._prefilling) \
+                < self.max_running:
             with self._lock:
                 if not self._queue:
                     return
                 req, t_submit = self._queue[0]
             try:
-                self.cache.reserve(req.rid,
-                                   len(req.tokens) + req.max_new_tokens)
+                start, matched, keys = self._admit(req)
             except AdmissionError:
                 return                      # pool pressure: stay queued
             with self._lock:
                 self._queue.popleft()
             seq = _Seq(req, t_submit)
+            seq.pf_pos = start
+            self._seed_publication(seq, matched, keys)
+            if self.prefill_chunk is not None:
+                # Chunked: the prompt advances one chunk per engine
+                # iteration, interleaved with decode — admission never
+                # stalls the running batch for a whole-prompt launch.
+                self._prefilling.append(seq)
+                continue
             self._prefill(seq)
             if seq.remaining <= 0:          # max_new_tokens == 1
                 self._evict(seq, results)
@@ -394,12 +579,29 @@ class ServeEngine(PagedModelRunner):
             logits=seq.logits if self.keep_logits else None,
             latency_s=now - seq.t_submit))
 
+    def _advance_prefill(self, results: List[Completion]) -> None:
+        """One chunk for the oldest prefilling sequence (FIFO — one
+        chunk per iteration keeps the decode cadence: a long prompt
+        costs ONE extra launch per running-batch token step, not a
+        whole-prompt stall)."""
+        if not self._prefilling:
+            return
+        seq = self._prefilling[0]
+        if self._prefill_chunk_step(seq):
+            self._prefilling.pop(0)
+            if seq.remaining <= 0:          # max_new_tokens == 1
+                self._evict(seq, results)
+            else:
+                self._running.append(seq)
+
     def step(self) -> List[Completion]:
-        """One engine iteration: join what fits, decode one token for
-        every running sequence, evict what finished. Returns the
-        completions this step produced."""
+        """One engine iteration: join what fits, advance one prefill
+        chunk (chunked mode), decode one token for every running
+        sequence, evict what finished. Returns the completions this
+        step produced."""
         results: List[Completion] = []
         self._join(results)
+        self._advance_prefill(results)
         if self._running:
             self._decode()
             still = []
@@ -416,12 +618,12 @@ class ServeEngine(PagedModelRunner):
         """Drive :meth:`step` until queue and batch drain (or
         ``max_steps``)."""
         out: List[Completion] = []
-        while (self.queue_depth or self._running) and \
-                (max_steps is None or self._steps < max_steps):
+        while (self.queue_depth or self._running or self._prefilling) \
+                and (max_steps is None or self._steps < max_steps):
             out.extend(self.step())
         return out
 
-    # -- the sequential reference -----------------------------------------
+    # -- the sequential reference ------------------------------------------
     def full_prefill_logits(self, tokens: Sequence[int]) -> np.ndarray:
         """Sequential full-prefill reference: process ``tokens`` as ONE
         isolated prefill on a scratch pool (same jitted shape family,
@@ -485,7 +687,7 @@ class ServeEngine(PagedModelRunner):
             "p50_ms": 1e3 * pct(0.50),
             "p99_ms": 1e3 * pct(0.99),
             "queue_depth": float(self.queue_depth),
-            "running": float(len(self._running)),
+            "running": float(self.running),
             "completed": float(self._completed),
             "steps": float(self._steps),
             "forwards": float(self.forwards),
@@ -501,6 +703,15 @@ class ServeEngine(PagedModelRunner):
             "tokens_per_forward": (self._emitted / self.forwards
                                    if self.forwards else 0.0),
             "acceptance_rate": 0.0,
+            # Prefix-cache / chunked-prefill telemetry (PR 13): zeros
+            # when the features are off — every engine flavor publishes
+            # the same schema, so the fleet's heartbeat consumers
+            # (router, autoscaler, portal) never branch on engine kind.
+            "prefix_cache_hit_rate": (
+                self.prefix_hit_blocks / self.prefix_lookup_blocks
+                if self.prefix_lookup_blocks else 0.0),
+            "blocks_shared": float(self.cache.adopted_total),
+            "prefill_chunks": float(self.prefill_chunks),
         }
         stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
@@ -511,13 +722,32 @@ class ServeEngine(PagedModelRunner):
         merged into :meth:`stats` before it is recorded/published."""
         return {}
 
-    def write_stats(self, path: str) -> None:
+    def prefix_digest(self, limit: int = 256) -> List[str]:
+        """The replica's block-content advertisement: the most recently
+        published chain keys. Rides the stats file → heartbeat → session
+        so the router can score cache overlap without asking the
+        replica; empty when prefix caching is off."""
+        if not self.prefix_cache:
+            return []
+        return self.cache.digest(limit)
+
+    def write_stats(self, path: str,
+                    extra: Optional[Dict[str, Any]] = None) -> None:
         """Atomically publish :meth:`stats` as JSON — the file the
         executor's heartbeat loop piggybacks to the AM (jax-free on the
-        reader side)."""
+        reader side). The payload adds the prefix digest (a list — the
+        one non-scalar the heartbeat schema carries) and any caller
+        ``extra`` (the replica adds its RPC port so the router can dial
+        it)."""
+        payload: Dict[str, Any] = dict(self.stats())
+        digest = self.prefix_digest()
+        if digest:
+            payload["prefix_digest"] = digest
+        if extra:
+            payload.update(extra)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as fh:
-            json.dump(self.stats(), fh)
+            json.dump(payload, fh)
         os.replace(tmp, path)
 
     # -- static-analysis hook ---------------------------------------------
@@ -534,3 +764,59 @@ class ServeEngine(PagedModelRunner):
                 jnp.zeros((b, self.nb_max), jnp.int32),
                 jnp.full((b, t), self.cache.oob_index, jnp.int32))
         return self._fn(b, t), args
+
+    def prefill_traced(self):
+        """``(jitted, example_args)`` of the canonical prefill-chunk
+        launch for ``tony analyze --config route`` — the ``(1, chunk)``
+        shape every non-final chunk of a chunked prefill rides (the
+        monolithic q_block row block when chunking is off). Same
+        builder, same rule suite as decode: zero inter-chip collectives
+        on the replica mesh, donated KV pools, pinned signature — the
+        chunk geometry is the ONLY compiled prefill shape the feature
+        declares."""
+        t = int(self.prefill_chunk or self.q_block)
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((1, t), jnp.int32),
+                jnp.zeros((1, t), jnp.int32),
+                jnp.zeros((1, self.nb_max), jnp.int32),
+                jnp.full((1, t), self.cache.oob_index, jnp.int32))
+        return self._fn(1, t), args
+
+
+class EngineFront:
+    """Thread-safe request front over ONE shared engine: each caller
+    submits and then takes turns advancing the loop until its own
+    completion lands, so overlapping calls ride one continuous batch.
+
+    Factored out of the replica (which fronts it over RPC) so the
+    router's in-process transport, the bench's multi-replica drive, and
+    :class:`tony_tpu.serve.replica.Replica` all run the IDENTICAL drive
+    discipline — the router tests compare routed against unrouted
+    serving through the same loop."""
+
+    def __init__(self, engine: ServeEngine):
+        self.engine = engine
+        self._drive = threading.Lock()
+        self._done: Dict[Any, Completion] = {}
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+
+    def generate(self, tokens: Sequence[int], max_new_tokens: int,
+                 rid: Optional[Any] = None) -> Completion:
+        """Submit one request and drive the shared engine until it
+        completes."""
+        if rid is None:
+            with self._rid_lock:
+                self._rid += 1
+                rid = f"req-{self._rid}"
+        self.engine.submit(Request(rid=rid, tokens=list(tokens),
+                                   max_new_tokens=int(max_new_tokens)))
+        while True:
+            with self._drive:
+                if rid in self._done:
+                    return self._done.pop(rid)
+                for c in self.engine.step():
+                    self._done[c.rid] = c
+            # Another thread may own the completion we need next round;
+            # yield so it can collect.
+            time.sleep(0)

@@ -11,6 +11,23 @@ position is written before any query can attend it (the flash-decode
 mask admits key ``j`` only for rows at position ``>= j``), so stale
 bytes are provably unread — and the reuse test pins that.
 
+Prefix sharing (PR 13) makes the pool CONTENT-ADDRESSED at block
+granularity: blocks are refcounted, and a full block whose positions
+are all verified-written can be *published* under its chain hash
+(:mod:`tony_tpu.serve.prefix` — the key covers the whole token prefix,
+because a KV row depends on every earlier token). Admission of a
+request whose prompt chain-matches published blocks *adopts* them
+(refcount++) instead of recomputing the prefill; the adopted bytes are
+bit-identical to what the prefill would have written (row independence
+at tile multiples — the serve plane's core numerics contract), so
+sharing cannot change an output bit. Writes go through
+:meth:`write_index`, which COPIES-ON-WRITE: a block with refcount > 1
+is never mutated — the writer gets a private device copy first. Freed
+blocks that carry a hash retire to an LRU *cached tier* instead of the
+LIFO free list: still addressable (a recently-evicted conversation's
+prefix revives on the next turn), reclaimed ref-aware LRU only when
+the LIFO tier runs dry.
+
 Speculative decoding (tony_tpu.serve.spec) adds a second, revocable
 allocation tier on top: :meth:`~PagedKVCache.spec_reserve` grows a
 table to cover drafted-but-unverified positions, :meth:`commit`
@@ -18,10 +35,10 @@ advances the per-sequence *write cursor* to the accepted length
 (promoting the blocks that cover it), and :meth:`rollback` truncates
 the block table back to the committed extent, returning the rejected
 extension to the free list in reverse order — so the LIFO reuse
-contract holds for speculation too. Rollback is free on the device
-side for the same stale-bytes reason: rows written at rejected
-positions sit above every committed row's position and are simply
-never gathered before the regenerating step overwrites them.
+contract holds for speculation too. Speculative extension blocks are
+always FRESH (never adopted, never published while revocable), so a
+rollback can never strand a shared block: it returns exactly the
+private extension, and an adopted prefix below the cursor is untouched.
 
 Capacity failures are a typed :class:`AdmissionError` carrying the
 needed/free block counts — an admission-control signal the engine (or a
@@ -31,6 +48,7 @@ allocator OOM.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, List, Sequence
 
 import jax.numpy as jnp
@@ -72,6 +90,20 @@ class PagedKVCache:
         # pressure.
         self._free: List[int] = list(range(n_blocks - 1, -1, -1))
         self._tables: Dict[Any, List[int]] = {}
+        # Prefix tier: per-block refcount (present iff allocated),
+        # content-key index (key -> block, block -> key), and the LRU
+        # cached tier — blocks with refcount 0 that still hold published
+        # content (most-recently-freed last; reclaimed from the front
+        # only when the LIFO tier is empty).
+        self._refs: Dict[int, int] = {}
+        self._index: Dict[str, int] = {}
+        self._key_of: Dict[int, str] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # Lifetime counters (the engine's stats surface reads them).
+        self.adopted_total = 0
+        self.cow_total = 0
+        self.lru_evicted_total = 0
+        self.revived_total = 0
         # Speculative tier (tony_tpu.serve.spec): per-sequence list of
         # blocks added by spec_reserve and not yet commit-promoted, plus
         # the write cursor — the highest position VERIFIED written (the
@@ -83,16 +115,49 @@ class PagedKVCache:
     # -- capacity ----------------------------------------------------------
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Blocks available to a new reservation: the LIFO free tier
+        plus the reclaimable LRU cached tier."""
+        return len(self._free) + len(self._lru)
 
     def blocks_for(self, length: int) -> int:
         """Blocks covering ``length`` positions."""
         return -(-max(0, int(length)) // self.block_size)
 
+    def _alloc_block(self) -> int:
+        """One fresh block: LIFO free list first; when dry, evict the
+        least-recently-freed cached block (dropping its index entry —
+        ref-aware by construction: only refcount-0 blocks live in the
+        cached tier). Callers check capacity first; running both tiers
+        dry here is an internal error."""
+        if self._free:
+            b = self._free.pop()
+        else:
+            b, _ = self._lru.popitem(last=False)
+            key = self._key_of.pop(b, None)
+            if key is not None:
+                self._index.pop(key, None)
+            self.lru_evicted_total += 1
+        self._refs[b] = 1
+        return b
+
+    def _release_block(self, b: int) -> None:
+        """Drop one reference; at zero the block retires to the cached
+        tier when published (still addressable) or the LIFO free list
+        when not."""
+        self._refs[b] -= 1
+        if self._refs[b] > 0:
+            return
+        del self._refs[b]
+        if b in self._key_of:
+            self._lru[b] = None
+            self._lru.move_to_end(b)
+        else:
+            self._free.append(b)
+
     # -- allocation --------------------------------------------------------
     def reserve(self, seq_id: Any, length: int) -> List[int]:
         """Grow ``seq_id``'s table to cover ``length`` positions,
-        allocating from the free list; raises :class:`AdmissionError`
+        allocating from the free tiers; raises :class:`AdmissionError`
         (state unchanged) when the pool can't supply the growth. The
         engine reserves a request's FULL extent (prompt + max new
         tokens) at admission, so decode can never hit pool exhaustion
@@ -106,15 +171,163 @@ class PagedKVCache:
                 f"permanent reserve")
         table = self._tables.setdefault(seq_id, [])
         needed = self.blocks_for(length) - len(table)
-        if needed > len(self._free):
+        if needed > self.free_blocks:
             raise AdmissionError(
                 f"KV pool exhausted: sequence {seq_id!r} needs {needed} "
-                f"more block(s) for {length} positions, {len(self._free)} "
-                f"free of {self.n_blocks}",
-                needed_blocks=needed, free_blocks=len(self._free))
+                f"more block(s) for {length} positions, "
+                f"{self.free_blocks} free of {self.n_blocks} "
+                f"({len(self._lru)} cached-reclaimable)",
+                needed_blocks=needed, free_blocks=self.free_blocks)
         for _ in range(max(0, needed)):
-            table.append(self._free.pop())
+            table.append(self._alloc_block())
         return list(table)
+
+    # -- prefix sharing ----------------------------------------------------
+    def match_prefix(self, keys: Sequence[str]) -> List[int]:
+        """Block ids of the longest indexed chain-key prefix of
+        ``keys`` — live or cached-tier blocks alike (adoption revives
+        the latter). Read-only: no refcounts move here."""
+        out: List[int] = []
+        for key in keys:
+            b = self._index.get(key)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def admit_shared(self, seq_id: Any, length: int,
+                     keys: Sequence[str] = ()) -> int:
+        """Fresh-admission reserve with prefix adoption, atomically:
+        match ``keys`` against the block index, adopt the matched chain
+        (refcount++, reviving cached-tier blocks), and allocate the
+        remaining ``length``-covering blocks fresh. Returns the number
+        of blocks adopted. Raises :class:`AdmissionError` with NOTHING
+        changed when the fresh growth cannot be supplied — a queued
+        request retries whole."""
+        if self._tables.get(seq_id):
+            raise ValueError(f"sequence {seq_id!r} already holds blocks "
+                             f"— admit_shared is a fresh-admission path")
+        matched = self.match_prefix(keys)
+        needed = self.blocks_for(length) - len(matched)
+        # Reviving a cached-tier block consumes reclaimable capacity
+        # too: count the fresh need against what is left after revival.
+        revive = sum(1 for b in matched if b in self._lru)
+        if needed > self.free_blocks - revive:
+            raise AdmissionError(
+                f"KV pool exhausted: sequence {seq_id!r} needs {needed} "
+                f"fresh block(s) beyond {len(matched)} shared for "
+                f"{length} positions, {self.free_blocks - revive} "
+                f"available of {self.n_blocks}",
+                needed_blocks=needed,
+                free_blocks=self.free_blocks - revive)
+        for b in matched:
+            if b in self._lru:
+                del self._lru[b]
+                self._refs[b] = 1
+                self.revived_total += 1
+            else:
+                self._refs[b] += 1
+            self._touch_key(b)
+        self.adopted_total += len(matched)
+        table = matched + [self._alloc_block()
+                           for _ in range(max(0, needed))]
+        self._tables[seq_id] = table
+        return len(matched)
+
+    def write_index(self, seq_id: Any, pos: int) -> int:
+        """Flat scatter index of position ``pos`` FOR WRITING: when the
+        covering block is shared (refcount > 1), the writer first gets a
+        private copy — device rows copied, table repointed, donor block
+        untouched — so a shared block is never mutated. The engine
+        routes every KV scatter target through here; reads (gather
+        tables) stay on :meth:`flat_index`."""
+        table = self._tables[seq_id]
+        bi, r = divmod(int(pos), self.block_size)
+        if bi >= len(table):
+            raise IndexError(
+                f"position {pos} beyond sequence {seq_id!r}'s "
+                f"{len(table)}-block reservation")
+        b = table[bi]
+        if self._refs[b] > 1:
+            table[bi] = self.cow_block(seq_id, bi)
+            b = table[bi]
+        return b * self.block_size + r
+
+    def cow_block(self, seq_id: Any, block_i: int) -> int:
+        """Copy-on-write of table slot ``block_i``: allocate a private
+        block, copy the shared block's device rows into it, drop one
+        reference on the donor. Raises :class:`AdmissionError` when no
+        block can be supplied (the engine's admission-time pre-COW of a
+        fully-matched tail makes that unreachable in steady state)."""
+        table = self._tables[seq_id]
+        src = table[block_i]
+        if self._refs[src] <= 1:
+            return src
+        if self.free_blocks < 1:
+            raise AdmissionError(
+                f"KV pool exhausted: sequence {seq_id!r} needs 1 block "
+                f"for a copy-on-write of shared block {src}, 0 free",
+                needed_blocks=1, free_blocks=0)
+        dst = self._alloc_block()
+        self.k = self.k.at[:, dst].set(self.k[:, src])
+        self.v = self.v.at[:, dst].set(self.v[:, src])
+        self._refs[src] -= 1
+        table[block_i] = dst
+        self.cow_total += 1
+        return dst
+
+    def _touch_key(self, block: int) -> None:
+        """Move ``block``'s index entry to the recent end — the digest
+        advertises the LAST ``limit`` keys, so recency must mean
+        most-recently-USED: without the touch, a popular system-prompt
+        stem published on day one ages out of the digest behind every
+        unique conversation tail, and the router's overlap score
+        collapses to zero for exactly the most-shared prefixes."""
+        key = self._key_of.get(block)
+        if key is not None:
+            del self._index[key]
+            self._index[key] = block
+
+    def publish_block(self, seq_id: Any, block_i: int, key: str) -> bool:
+        """Index table slot ``block_i`` under chain-``key`` so later
+        admissions can adopt it. First publisher wins: an existing
+        index entry for ``key`` (same content, another block) stays —
+        repointing would strand nothing but churn the digest — but a
+        re-publication refreshes its digest recency (a second producer
+        of the same content proves it hot). The CALLER owns the
+        correctness contract: every position of the block must be
+        verified-written (full block, inside the committed extent)."""
+        table = self._tables[seq_id]
+        b = table[block_i]
+        if key in self._index:
+            self._touch_key(self._index[key])
+            return False
+        if b in self._key_of:
+            return False
+        self._index[key] = b
+        self._key_of[b] = key
+        return True
+
+    def digest(self, limit: int = 256) -> List[str]:
+        """Up to ``limit`` most-recently-used chain keys (publication
+        and adoption both refresh recency) — the compact content
+        advertisement a replica ships on its heartbeat for the
+        router's overlap scoring."""
+        keys = list(self._index)
+        return keys[-limit:]
+
+    def shared_blocks(self) -> int:
+        """Blocks currently referenced by more than one table."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    def ref(self, block: int) -> int:
+        """Current refcount of ``block`` (0 = free/cached tier)."""
+        return self._refs.get(block, 0)
+
+    def cached_blocks(self) -> List[int]:
+        """The LRU cached tier, least-recently-freed first (test
+        surface for the partition + eviction-order invariants)."""
+        return list(self._lru)
 
     # -- speculative tier (tony_tpu.serve.spec) ----------------------------
     def committed_len(self, seq_id: Any) -> int:
@@ -133,14 +346,14 @@ class PagedKVCache:
         the write cursor."""
         table = self._tables.setdefault(seq_id, [])
         needed = self.blocks_for(length) - len(table)
-        if needed > len(self._free):
+        if needed > self.free_blocks:
             raise AdmissionError(
                 f"KV pool exhausted: sequence {seq_id!r} needs {needed} "
                 f"more block(s) for a {length}-position speculative "
-                f"extension, {len(self._free)} free of {self.n_blocks}",
-                needed_blocks=needed, free_blocks=len(self._free))
+                f"extension, {self.free_blocks} free of {self.n_blocks}",
+                needed_blocks=needed, free_blocks=self.free_blocks)
         if needed > 0:
-            added = [self._free.pop() for _ in range(needed)]
+            added = [self._alloc_block() for _ in range(needed)]
             table.extend(added)
             self._spec.setdefault(seq_id, []).extend(added)
         return list(table)
@@ -170,23 +383,30 @@ class PagedKVCache:
         reverse allocation order (so the LIFO handout order is the
         mirror of the allocation — the reuse test pins it). The write
         cursor is untouched: it already names the accepted length.
-        Returns the number of blocks freed (0 when the reservation was
+        Speculative blocks are private by construction (fresh-allocated,
+        never published), so this can never strand a shared block — an
+        adopted prefix below the cursor keeps every reference. Returns
+        the number of blocks freed (0 when the reservation was
         full-extent and speculation grew nothing)."""
         spec = self._spec.pop(seq_id, [])
         if spec:
             table = self._tables[seq_id]
             del table[len(table) - len(spec):]
-            self._free.extend(reversed(spec))
+            for b in reversed(spec):
+                self._release_block(b)
         return len(spec)
 
     def free_seq(self, seq_id: Any) -> int:
-        """Return all of ``seq_id``'s blocks to the pool — the
-        speculative tail included; returns the count (0 for an unknown
-        id — idempotent eviction)."""
+        """Drop all of ``seq_id``'s references — the speculative tail
+        included; returns the table length (0 for an unknown id —
+        idempotent eviction). Published blocks the sequence was the
+        last holder of retire to the cached tier, still adoptable by a
+        follow-up request (the recently-evicted-conversation hit)."""
         self._spec.pop(seq_id, None)
         self._committed.pop(seq_id, None)
         table = self._tables.pop(seq_id, [])
-        self._free.extend(reversed(table))
+        for b in reversed(table):
+            self._release_block(b)
         return len(table)
 
     def table(self, seq_id: Any) -> List[int]:
@@ -194,7 +414,8 @@ class PagedKVCache:
 
     def owned_blocks(self) -> Dict[Any, List[int]]:
         """Live ownership snapshot (test surface for the alloc/free/reuse
-        invariants: disjoint tables, free+owned partitions the pool)."""
+        invariants: refcounts partition the pool with the free tiers;
+        tables may intersect exactly on shared prefix blocks)."""
         return {sid: list(t) for sid, t in self._tables.items()}
 
     # -- device-side addressing --------------------------------------------
@@ -214,7 +435,8 @@ class PagedKVCache:
 
     def flat_index(self, seq_id: Any, pos: int) -> int:
         """Flat scatter index of position ``pos`` into the
-        ``[n_blocks·block_size]``-flattened pool."""
+        ``[n_blocks·block_size]``-flattened pool (read addressing; a
+        WRITE target must go through :meth:`write_index`)."""
         table = self._tables[seq_id]
         b, r = divmod(int(pos), self.block_size)
         if b >= len(table):

@@ -32,7 +32,6 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
@@ -40,8 +39,10 @@ from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
                            SERVE_DRAFT_MODEL, SERVE_DRAFT_MODEL_KWARGS,
                            SERVE_DRAFT_NGRAM_MAX, SERVE_DTYPE_POLICY,
                            SERVE_MAX_RUNNING, SERVE_MESH, SERVE_MODEL,
-                           SERVE_MODEL_KWARGS, SERVE_PORT, SERVE_SPEC_K)
-from tony_tpu.serve.engine import Completion, Request, ServeEngine
+                           SERVE_MODEL_KWARGS, SERVE_PORT,
+                           SERVE_PREFILL_CHUNK, SERVE_PREFIX_CACHE,
+                           SERVE_SPEC_K)
+from tony_tpu.serve.engine import Completion, EngineFront, ServeEngine
 
 
 class Replica:
@@ -58,7 +59,9 @@ class Replica:
                  draft_model_name: Optional[str] = None,
                  draft_model_kwargs: Optional[Dict[str, Any]] = None,
                  draft_ckpt_dir: Optional[str] = None,
-                 ngram_max: int = 3):
+                 ngram_max: int = 3,
+                 prefix_cache: bool = False,
+                 prefill_chunk: Optional[int] = None):
         from tony_tpu._trace import trace_record
         from tony_tpu.models import get_model
 
@@ -89,23 +92,26 @@ class Replica:
                 self.model, params, spec_k=spec_k, ctx_max=ctx_max,
                 block_size=block_size, q_block=q_block, n_blocks=n_blocks,
                 max_running=max_running, mesh=mesh,
-                keep_logits=keep_logits, tag=tag, **draft_kw)
+                keep_logits=keep_logits, tag=tag,
+                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+                **draft_kw)
         else:
             self.engine = ServeEngine(
                 self.model, params, ctx_max=ctx_max,
                 block_size=block_size, q_block=q_block, n_blocks=n_blocks,
                 max_running=max_running, mesh=mesh,
-                keep_logits=keep_logits, tag=tag)
+                keep_logits=keep_logits, tag=tag,
+                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk)
         trace_record("serve", "replica", model=model_name,
                      ckpt_step=step, path_prefix=prefix,
                      dtype_policy=dtype_policy, spec_k=int(spec_k),
                      draft_model=draft_model_name or
                      ("ngram" if spec_k else None),
+                     prefix_cache=bool(prefix_cache),
+                     prefill_chunk=prefill_chunk,
                      mesh_axes=dict(getattr(mesh, "shape", {}) or {}))
-        self._drive = threading.Lock()
-        self._done: Dict[Any, Completion] = {}
-        self._rid = 0
-        self._rid_lock = threading.Lock()
+        self._front = EngineFront(self.engine)
+        self.port: Optional[int] = None
 
     @staticmethod
     def _restore_params(model: Any, ckpt_dir: str, *,
@@ -151,22 +157,10 @@ class Replica:
                  rid: Optional[Any] = None) -> Completion:
         """Submit one request and drive the shared engine until it
         completes. Thread-safe: concurrent callers interleave on the
-        drive lock, so their requests ride one continuous batch."""
-        if rid is None:
-            with self._rid_lock:
-                self._rid += 1
-                rid = f"req-{self._rid}"
-        self.engine.submit(Request(rid=rid, tokens=list(tokens),
-                                   max_new_tokens=int(max_new_tokens)))
-        while True:
-            with self._drive:
-                if rid in self._done:
-                    return self._done.pop(rid)
-                for c in self.engine.step():
-                    self._done[c.rid] = c
-            # Another thread may own the completion we need next round;
-            # yield so it can collect.
-            time.sleep(0)
+        drive lock (:class:`~tony_tpu.serve.engine.EngineFront` — the
+        same loop the router's in-process transport runs), so their
+        requests ride one continuous batch."""
+        return self._front.generate(tokens, max_new_tokens, rid=rid)
 
     # -- RPC front ---------------------------------------------------------
     def rpc_handler(self) -> "_ReplicaRpcHandler":
@@ -189,7 +183,13 @@ class Replica:
             while not stop.wait(stats_every_s):
                 if stats_path:
                     try:
-                        self.engine.write_stats(stats_path)
+                        # rpc_port rides the stats file → heartbeat →
+                        # session so the request router can DIAL this
+                        # replica (task.port is the rendezvous port,
+                        # not the serve RPC) — and the prefix digest
+                        # rides the same payload for overlap scoring.
+                        self.engine.write_stats(
+                            stats_path, extra={"rpc_port": server.port})
                     except OSError:
                         pass
         finally:
@@ -251,7 +251,9 @@ def main() -> int:
         draft_model_kwargs=json.loads(
             conf.get(SERVE_DRAFT_MODEL_KWARGS) or "{}"),
         draft_ckpt_dir=conf.get(SERVE_DRAFT_CKPT_DIR),
-        ngram_max=conf.get_int(SERVE_DRAFT_NGRAM_MAX, 3))
+        ngram_max=conf.get_int(SERVE_DRAFT_NGRAM_MAX, 3),
+        prefix_cache=conf.get_bool(SERVE_PREFIX_CACHE, False),
+        prefill_chunk=conf.get_int(SERVE_PREFILL_CHUNK, 0) or None)
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

@@ -1,0 +1,418 @@
+"""Cross-replica request router: the fleet — not a replica — becomes
+the unit of serving throughput.
+
+PR 10 made one replica elastic behind the AM's autoscaler; this module
+is the missing front: a gateway-side request router over the live
+replica set that decides WHERE each generation runs. Arax's framing
+(PAPERS 2305.01291 — work decoupled from concrete accelerator
+instances) lands here as three scoring signals per replica, all carried
+by telemetry the fleet already ships on the executor heartbeat:
+
+* **prefix-cache overlap** — the router chain-hashes the prompt's KV
+  blocks (:mod:`tony_tpu.serve.prefix`, the identical key scheme the
+  replica pool uses) and matches them against each replica's advertised
+  block digest: a replica already holding the conversation's prefix
+  skips that much prefill outright, so overlap is worth real launches,
+  not just queue position;
+* **load** — queue depth and in-flight occupancy (the autoscaler's
+  pressure signals, reused);
+* **tail latency** — p99 over the replica's stats window.
+
+Sticky session affinity rides on top: a ``session_id`` pins its
+follow-up turns to the replica that served them (which is exactly where
+the prefix cache holds the conversation), until that replica retires or
+fails — then the router re-dispatches against the scores and re-pins.
+Failover is part of dispatch, not an afterthought: a dead replica's
+request re-routes to the next-best candidate and the replica is marked
+down until a fresh heartbeat revives it.
+
+Jax-free by the same layering rule as ``serve.scaling``: the router
+runs on a gateway host (or inside the AM) with no accelerator stack —
+transports are pluggable, so tests and benches drive in-process
+:class:`~tony_tpu.serve.engine.EngineFront` replicas while production
+dials the replica RPC port carried on the heartbeat
+(``rpc_port``/host, surfaced through ``session.serve_endpoints`` and
+the AM's ``serve_endpoints`` RPC verb).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from tony_tpu.serve import prefix as prefix_mod
+
+
+class NoReplicaError(RuntimeError):
+    """Every known replica is retired or down — the fleet cannot take
+    the request (surface to the caller as back-pressure, like an
+    AdmissionError one level up)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterPolicy:
+    """Scoring weights for one route decision. The score is
+    ``cache_weight · overlap_fraction − queue_weight · queue_depth −
+    p99_weight · p99_seconds`` — overlap is normalized to the prompt's
+    block count (a whole-prompt hit is worth ``cache_weight`` no matter
+    the prompt length), load terms are raw (one queued request offsets
+    a ``1/queue_weight`` overlap fraction). Deliberately linear and
+    jax-free: unit-testable like :class:`~tony_tpu.serve.scaling.
+    ScalingPolicy`, and the AM glue stays a dumb applier."""
+    cache_weight: float = 4.0
+    queue_weight: float = 1.0
+    p99_weight: float = 0.5
+    # A replica whose last heartbeat is older than this is scored as
+    # down (dispatch still tries it LAST rather than never — a stale
+    # clock must not brick a one-replica fleet).
+    stale_s: float = 30.0
+
+    def __post_init__(self):
+        if self.cache_weight < 0 or self.queue_weight < 0 \
+                or self.p99_weight < 0:
+            raise ValueError("router weights must be >= 0, got "
+                             f"{self.cache_weight}/{self.queue_weight}/"
+                             f"{self.p99_weight}")
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """The router's picture of one replica: identity, transport, and
+    the latest heartbeat-derived telemetry."""
+    name: str
+    address: Optional[str] = None        # host:port of the replica RPC
+    client: Optional[Any] = None         # in-process transport override
+    queue_depth: float = 0.0
+    running: float = 0.0
+    p99_ms: float = 0.0
+    digest: frozenset = frozenset()
+    last_seen: float = 0.0
+    alive: bool = True
+    retired: bool = False
+
+    def update(self, stats: Dict[str, Any], *, now: float) -> None:
+        self.queue_depth = float(stats.get("queue_depth", 0.0) or 0.0)
+        self.running = float(stats.get("running", 0.0) or 0.0)
+        self.p99_ms = float(stats.get("p99_ms", 0.0) or 0.0)
+        digest = stats.get("prefix_digest")
+        if digest is not None:
+            self.digest = frozenset(str(k) for k in digest)
+        self.last_seen = now
+        self.alive = True
+
+
+def score(policy: RouterPolicy, view: ReplicaView,
+          prompt_keys: Sequence[str]) -> float:
+    """One replica's score for one prompt (pure — the unit-test
+    surface). Cache overlap counts the longest chain-key PREFIX present
+    in the replica's digest: chain keys make an interior match without
+    its ancestors useless, so intersection would overcount."""
+    overlap = 0.0
+    if prompt_keys and view.digest:
+        overlap = prefix_mod.match_overlap(prompt_keys, view.digest) \
+            / len(prompt_keys)
+    return (policy.cache_weight * overlap
+            - policy.queue_weight * (view.queue_depth + view.running)
+            - policy.p99_weight * view.p99_ms / 1e3)
+
+
+class RequestRouter:
+    """Route + dispatch requests over the elastic replica set.
+
+    Thread-safe. ``block_size`` must match the fleet's engine geometry
+    (the chain keys are block-aligned); ``dial`` turns an address into
+    a transport for RPC replicas — anything with
+    ``generate(tokens, max_new_tokens, rid=...)`` returning an object
+    or mapping with a ``tokens`` field works, so in-process
+    :class:`~tony_tpu.serve.engine.EngineFront` instances register
+    directly via ``client=``.
+    """
+
+    def __init__(self, *, block_size: int = 16,
+                 policy: Optional[RouterPolicy] = None,
+                 dial: Optional[Any] = None,
+                 dial_timeout_s: float = 15.0):
+        if block_size <= 0:
+            raise ValueError(f"need positive block_size, got {block_size}")
+        self.block_size = int(block_size)
+        self.policy = policy or RouterPolicy()
+        # Short transport retry window ON PURPOSE: a dead replica must
+        # fail the attempt fast so dispatch can fail over — the long
+        # wait belongs to the generation itself, not to redialing a
+        # refused connection.
+        self.dial_timeout_s = float(dial_timeout_s)
+        self._dial = dial or (lambda addr: _rpc_dial(
+            addr, self.dial_timeout_s))
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaView] = {}
+        self._affinity: Dict[Any, str] = {}
+        # Lifetime counters (the router's own stats surface).
+        self.dispatched = 0
+        self.failovers = 0
+        self.affinity_hits = 0
+        self.cache_routed = 0            # decisions won on overlap > 0
+
+    # -- membership --------------------------------------------------------
+    def upsert_replica(self, name: str, *, address: Optional[str] = None,
+                       client: Optional[Any] = None,
+                       stats: Optional[Dict[str, Any]] = None) -> None:
+        """Add or refresh one replica (heartbeat ingestion path). A
+        refresh revives a down-marked replica — the heartbeat is the
+        liveness source of truth, a failed dispatch only a hint."""
+        now = time.monotonic()
+        with self._lock:
+            view = self._replicas.get(name)
+            if view is None:
+                if address is None and client is None:
+                    raise ValueError(f"new replica {name!r} needs an "
+                                     f"address or an in-process client")
+                view = ReplicaView(name=name)
+                self._replicas[name] = view
+            if address is not None:
+                view.address = address
+            if client is not None:
+                view.client = client
+            view.retired = False
+            if stats:
+                view.update(stats, now=now)
+            else:
+                view.last_seen = now
+                view.alive = True
+
+    def retire_replica(self, name: str) -> None:
+        """Scale-down/teardown: the replica stops receiving new work;
+        sessions pinned to it re-route (and re-pin) on their next
+        turn."""
+        with self._lock:
+            view = self._replicas.get(name)
+            if view is not None:
+                view.retired = True
+
+    def refresh_from_task_infos(self, infos: Sequence[Dict[str, Any]],
+                                *, job_type: str = "serve") -> None:
+        """Ingest the AM's ``get_task_infos`` wire form (or the
+        ``serve_endpoints`` verb's output): live serve tasks whose
+        heartbeat carried an ``rpc_port`` become routable replicas at
+        ``host:rpc_port``; terminal tasks retire. One call wires the
+        router to the whole elastic fleet — scale-ups appear, retired
+        replicas drain, no per-replica plumbing."""
+        for info in infos:
+            if info.get("job_type", job_type) != job_type:
+                continue
+            name = f"{info.get('job_type', job_type)}:{info['index']}"
+            metrics = dict(info.get("serve_metrics") or {})
+            terminal = info.get("status") in ("SUCCEEDED", "FAILED",
+                                              "LOST", "KILLED")
+            if terminal:
+                self.retire_replica(name)
+                continue
+            port = metrics.get("rpc_port")
+            host = info.get("host")
+            if not port or not host:
+                continue            # not serving yet (no stats file)
+            self.upsert_replica(name, address=f"{host}:{int(port)}",
+                                stats=metrics)
+
+    def replicas(self) -> List[ReplicaView]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -- routing -----------------------------------------------------------
+    def route(self, tokens: Sequence[int],
+              session_id: Optional[Any] = None) -> str:
+        """The replica name for one request — sticky affinity first
+        (the session's history lives in that replica's prefix cache),
+        then the policy score over live candidates."""
+        now = time.monotonic()
+        keys = prefix_mod.chain_keys(tokens, self.block_size)
+        with self._lock:
+            if session_id is not None:
+                pinned = self._replicas.get(
+                    self._affinity.get(session_id, ""))
+                if pinned is not None and pinned.alive \
+                        and not pinned.retired:
+                    self.affinity_hits += 1
+                    return pinned.name
+            live = [v for v in self._replicas.values()
+                    if v.alive and not v.retired
+                    and now - v.last_seen <= self.policy.stale_s]
+            if not live:
+                # Stale-but-not-retired beats refusing outright.
+                live = [v for v in self._replicas.values()
+                        if v.alive and not v.retired]
+            if not live:
+                raise NoReplicaError(
+                    f"no live replica among {len(self._replicas)} known")
+            best = max(live, key=lambda v: (score(self.policy, v, keys),
+                                            v.name))
+            if keys and best.digest \
+                    and prefix_mod.match_overlap(keys, best.digest):
+                self.cache_routed += 1
+            if session_id is not None:
+                self._affinity[session_id] = best.name
+            return best.name
+
+    def _client_of(self, name: str) -> Any:
+        with self._lock:
+            view = self._replicas[name]
+            if view.client is not None:
+                return view.client
+            return self._dial(view.address)
+
+    def dispatch(self, tokens: Sequence[int], max_new_tokens: int, *,
+                 session_id: Optional[Any] = None,
+                 rid: Optional[Any] = None,
+                 max_attempts: int = 3) -> Dict[str, Any]:
+        """Route + generate with failover: a replica whose TRANSPORT
+        fails (dead socket, refused dial — ``OSError`` family) is
+        marked down (until its next heartbeat) and the request
+        re-dispatches to the next-best candidate — retirement or a
+        crash costs the caller a retry, never the request.
+        Request-level errors (an ``AdmissionError`` for an oversized
+        prompt, an application ``RpcError``) propagate to the caller
+        untouched: the replica is healthy, the REQUEST is bad, and
+        down-marking on it would let one misbehaving client poison the
+        whole fleet."""
+        last_err: Optional[Exception] = None
+        for _ in range(max(1, int(max_attempts))):
+            name = self.route(tokens, session_id)
+            try:
+                out = self._client_of(name).generate(
+                    list(int(t) for t in tokens), int(max_new_tokens),
+                    rid=rid)
+            except OSError as e:    # transport fault (ConnectionError,
+                last_err = e        # timeout, refused dial, ...)
+                with self._lock:
+                    view = self._replicas.get(name)
+                    if view is not None:
+                        view.alive = False
+                    if session_id is not None and \
+                            self._affinity.get(session_id) == name:
+                        del self._affinity[session_id]
+                    self.failovers += 1
+                continue
+            with self._lock:
+                self.dispatched += 1
+            if not isinstance(out, dict):
+                out = {"rid": getattr(out, "rid", rid),
+                       "tokens": list(out.tokens),
+                       "latency_ms": round(1e3 * out.latency_s, 3)}
+            out["replica"] = name
+            return out
+        raise NoReplicaError(
+            f"dispatch failed after {max_attempts} attempt(s): "
+            f"{last_err}") from last_err
+
+    # -- telemetry ---------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            live = sum(1 for v in self._replicas.values()
+                       if v.alive and not v.retired)
+            return {
+                "replicas": float(len(self._replicas)),
+                "replicas_live": float(live),
+                "dispatched": float(self.dispatched),
+                "failovers": float(self.failovers),
+                "affinity_hits": float(self.affinity_hits),
+                "cache_routed": float(self.cache_routed),
+                "sessions": float(len(self._affinity)),
+            }
+
+
+def _rpc_dial(address: str, timeout: float) -> Any:
+    """Default transport: the control-plane JSON-lines RPC client
+    against a replica's ``generate`` verb (lazy import — the RPC stack
+    only loads when a network replica is actually dialed)."""
+    from tony_tpu.rpc import RpcClient
+
+    class _Front:
+        def generate(self, tokens, max_new_tokens, rid=None):
+            with RpcClient(address, timeout=timeout) as client:
+                return client.call("generate", tokens=tokens,
+                                   max_new_tokens=max_new_tokens,
+                                   rid=rid)
+
+    return _Front()
+
+
+class RouterRpcHandler:
+    """RPC verbs of one router front (JSON-lines wire, same as the
+    AM's and the replica's) — ``generate`` forwards through
+    :meth:`RequestRouter.dispatch`, so a gateway client speaks ONE verb
+    whether it dials a replica or the fleet."""
+
+    def __init__(self, router: RequestRouter):
+        self.router = router
+
+    def rpc_generate(self, tokens: List[int], max_new_tokens: int = 16,
+                     rid: Optional[str] = None,
+                     session_id: Optional[str] = None) -> Dict[str, Any]:
+        return self.router.dispatch(tokens, max_new_tokens, rid=rid,
+                                    session_id=session_id)
+
+    def rpc_router_stats(self) -> Dict[str, float]:
+        return self.router.stats()
+
+
+class RouterServer:
+    """The fleet's network front door: an RPC server around one
+    :class:`RequestRouter`, optionally polling an AM for the live
+    replica set (``am_address`` + ``poll_s``) so membership tracks the
+    autoscaler with zero manual wiring. Front it with
+    :class:`tony_tpu.proxy.ProxyServer` for gateway access, exactly
+    like a replica."""
+
+    def __init__(self, router: RequestRouter, *, host: str = "0.0.0.0",
+                 port: int = 0, am_address: Optional[str] = None,
+                 poll_s: float = 2.0):
+        from tony_tpu.rpc import RpcServer
+
+        self.router = router
+        self.am_address = am_address
+        self.poll_s = float(poll_s)
+        self._server = RpcServer(RouterRpcHandler(router), host=host,
+                                 port=port)
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def start(self) -> "RouterServer":
+        self._server.start()
+        if self.am_address:
+            self._poller = threading.Thread(target=self._poll_loop,
+                                            name="tony-router-poll",
+                                            daemon=True)
+            self._poller.start()
+        return self
+
+    def _poll_loop(self) -> None:
+        from tony_tpu.rpc import RpcClient
+
+        while not self._stop.wait(self.poll_s):
+            try:
+                with RpcClient(self.am_address, timeout=5.0) as client:
+                    infos = client.call("serve_endpoints")
+                self.router.refresh_from_task_infos(infos)
+            except Exception:  # noqa: BLE001 — AM mid-restart; re-poll
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2)
+        self._server.stop()
+
+    def __enter__(self) -> "RouterServer":
+        return self.start()
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()

@@ -358,7 +358,10 @@ class SpecEngine(ServeEngine):
             tokens[i, 1:1 + ks[i]] = drafts[i]
             positions[i] = p0 + np.arange(t, dtype=np.int32)
             for j in range(ks[i] + 1):
-                flat[i, j] = self.cache.flat_index(s.rid, p0 + j)
+                # write_index: a forked sequence's first verify rows can
+                # land in an adopted prefix block (full-cover admission)
+                # — COW keeps the donor's bytes untouched.
+                flat[i, j] = self.cache.write_index(s.rid, p0 + j)
         tables[:len(seqs)] = self.cache.table_array(
             [s.rid for s in seqs], self.nb_max)
         logits = self._run_fn(b, t, tokens, positions, tables, flat)
@@ -389,10 +392,12 @@ class SpecEngine(ServeEngine):
         self.draft.observe(seqs)
 
     def step(self):
-        """One engine iteration: join what fits, draft + verify one
-        launch for the whole running batch, evict what finished."""
+        """One engine iteration: join what fits, advance one prefill
+        chunk (chunked mode), draft + verify one launch for the whole
+        running batch, evict what finished."""
         results = []
         self._join(results)
+        self._advance_prefill(results)
         if self._running:
             self._verify_round()
             still = []

@@ -22,6 +22,7 @@ import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from tony_tpu import constants
+from tony_tpu import util
 from tony_tpu.conf import TonyConfig
 
 
@@ -65,7 +66,13 @@ class TonyTask:
         # are the only scale-DOWN victims, so the conf-declared floor
         # stays intact.
         self.elastic = elastic
-        self.status = TaskStatus.NEW
+        self._status = TaskStatus.NEW
+        # Every status this task has held, in order (wire-visible via
+        # to_info): the client's monitor poll is sampled, so a fast
+        # worker can pass REGISTERED→RUNNING→SUCCEEDED between polls —
+        # the history lets the monitor print every transition it
+        # missed instead of silently skipping RUNNING.
+        self.status_history: List[str] = [TaskStatus.NEW.value]
         self.host: Optional[str] = None
         self.port: Optional[int] = None          # rendezvous port registered by executor
         self.container_id: Optional[str] = None
@@ -79,9 +86,11 @@ class TonyTask:
         # piggyback; None until a tony.ckpt.dir executor reports one).
         self.ckpt_step: Optional[int] = None
         # Latest serving telemetry this task piggybacked on its
-        # heartbeat (qps / p99_ms / queue_depth — tony_tpu.serve): what
-        # the AM's replica autoscaler decides on.
-        self.serve_metrics: Dict[str, float] = {}
+        # heartbeat (qps / p99_ms / queue_depth / prefix_cache_hit_rate
+        # / blocks_shared / prefill_chunks, plus the router's
+        # prefix_digest key list and rpc_port — tony_tpu.serve): what
+        # the AM's replica autoscaler and the request router decide on.
+        self.serve_metrics: Dict[str, object] = {}
         self.metrics: Dict[str, float] = {}
         # Timeline of TaskMonitor samples (reference: the per-task metric
         # history MetricsRpc accumulates for the portal). Bounded: at the
@@ -89,6 +98,16 @@ class TonyTask:
         self.metrics_history: List[Dict[str, float]] = []
 
     METRICS_HISTORY_CAP = 512
+
+    @property
+    def status(self) -> TaskStatus:
+        return self._status
+
+    @status.setter
+    def status(self, value: TaskStatus) -> None:
+        self._status = value
+        if self.status_history[-1] != value.value:
+            self.status_history.append(value.value)
 
     def record_metrics(self, metrics: Dict[str, float]) -> Dict[str, float]:
         """Record one TaskMonitor sample; returns the normalized sample."""
@@ -120,6 +139,7 @@ class TonyTask:
             "job_type": self.job_type,
             "index": self.index,
             "status": self.status.value,
+            "status_history": list(self.status_history),
             "host": self.host,
             "port": self.port,
             "tracked": self.tracked,
@@ -265,8 +285,7 @@ class TonySession:
             t.ckpt_step = int(ckpt_step)
         if serve:
             try:
-                t.serve_metrics = {str(k): float(v)
-                                   for k, v in dict(serve).items()}
+                t.serve_metrics = util.normalize_serve_telemetry(serve)
             except (TypeError, ValueError):
                 pass          # malformed telemetry must not sink liveness
 
@@ -305,6 +324,18 @@ class TonySession:
             return [dict(t.serve_metrics) for t in self._tasks.values()
                     if t.job_type == job_type and not t.status.is_terminal
                     and t.serve_metrics]
+
+    def serve_endpoints(self, job_type: str = "serve") -> List[Dict[str, object]]:
+        """Wire form of every replica of ``job_type`` that has reported
+        serving telemetry — what the request router
+        (:mod:`tony_tpu.serve.router`) ingests to track the elastic
+        fleet: live replicas whose heartbeat carried an ``rpc_port``
+        become routable at ``host:rpc_port``; terminal entries ride
+        along so the router retires them."""
+        with self.lock:
+            return [t.to_info() for t in self._tasks.values()
+                    if t.job_type == job_type
+                    and (t.serve_metrics or t.status.is_terminal)]
 
     def last_committed_step(self) -> Optional[int]:
         """Newest checkpoint step any executor has reported committed —

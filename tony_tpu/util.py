@@ -77,3 +77,19 @@ def restore_site_dirs() -> None:
     for d in os.environ.get(ENV_SITE_DIRS, "").split(os.pathsep):
         if d:
             site.addsitedir(d)
+
+
+def normalize_serve_telemetry(raw: Dict) -> Dict[str, object]:
+    """One normalization for the serve heartbeat schema, shared by the
+    executor's stats-file reader and the session's heartbeat ingest so
+    the two layers cannot drift: scalars become floats, list values
+    (the router's ``prefix_digest`` block-key list — the schema's one
+    non-scalar) become string lists. Raises on anything else, so both
+    callers keep their own advisory-telemetry failure handling."""
+    out: Dict[str, object] = {}
+    for k, v in dict(raw).items():
+        if isinstance(v, (list, tuple)):
+            out[str(k)] = [str(x) for x in v]
+        else:
+            out[str(k)] = float(v)
+    return out
