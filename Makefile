@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -101,11 +101,29 @@ tier1-spec:
 tier1-route:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m route -p no:cacheprovider -p no:xdist -p no:randomly
 
-# The jnp.concatenate/stack pack-site lint (the jax-0.4 GSPMD concat-
-# reshard footgun, machine-checked): every call site outside the approved
-# pack planes must carry an audited 'packsite: region-local' pragma.
+# Concurrency-plane marker leg — the lock-discipline lint + lock-order
+# witness + thread-hygiene audit: seeded violations per rule, the
+# package tree clean at HEAD, the witness catching a seeded lock-order
+# inversion, and the genuinely multi-threaded randomized kvcache
+# interleave (N threads of admit/fork/write/spec/evict with the
+# refcount/free/LRU partition pinned at every quiescent point). Runs the
+# FULL conc selection (slow included): the threaded stress tests are
+# slow-marked to keep tier1-verify inside its (tight — ROADMAP) 870 s
+# budget, but this named leg is the lane's gate and must see them.
+tier1-conc:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m conc -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Source lints, machine-checked: (1) the jnp.concatenate/stack pack-site
+# lint (the jax-0.4 GSPMD concat-reshard footgun) — every call site
+# outside the approved pack planes must carry an audited
+# 'packsite: region-local' pragma; (2) the concurrency plane — lock
+# discipline (guarded-elsewhere mutations need the lock or an audited
+# '# lockfree:' pragma), lock-order cycles over the static nested-with
+# graph, and the thread-hygiene audit (daemon or joined), diffed against
+# the committed blessings baseline.
 lint:
 	python -m tony_tpu.analysis.srclint tony_tpu
+	python -m tony_tpu.analysis.concurrency tony_tpu --baseline tests/signatures/concurrency.json
 
 # The tests tier-1 excludes to stay inside its timeout (heavy multi-device
 # compiles): run them standalone, no timeout.

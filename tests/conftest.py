@@ -47,6 +47,7 @@ _TIER_BY_MODULE = {
     "test_pipeline": "jit", "test_overlap": "jit", "test_multislice": "jit",
     "test_sched": "jit",
     "test_analysis": "jit",
+    "test_concurrency": "jit",
     "test_serve": "jit",
     "test_spec": "jit",
     "test_route": "jit",
@@ -61,3 +62,57 @@ def pytest_collection_modifyitems(items):
         # a marker-filtered run must never skip a new file with no signal.
         tier = _TIER_BY_MODULE.get(item.module.__name__, "jit")
         item.add_marker(getattr(pytest.mark, tier))
+
+
+# ---------------------------------------------------------------------------
+# Thread-leak guard (the concurrency-analysis plane's test-side half):
+# every test must leave no stray NON-daemon thread behind — a non-daemon
+# survivor outlives pytest silently and is exactly the shutdown-hygiene
+# drift the static audit polices in the package. Daemon threads are not
+# policed here (the interpreter reaps them; the audit still requires the
+# construction site to declare them), and neither are the long-lived
+# helpers below, discovered while landing the guard.
+# ---------------------------------------------------------------------------
+
+_THREAD_ALLOWLIST_PREFIXES = (
+    # concurrent.futures keeps idle non-daemon workers for reuse and joins
+    # them at interpreter exit; the AM's launch pool ("launch_*") is
+    # shut down per attempt but its last workers unwind asynchronously.
+    "ThreadPoolExecutor",
+    "launch",
+    # jax/XLA host runtime helpers (platform-dependent; created once per
+    # process on first compile, never per test).
+    "jax_",
+)
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard():
+    import threading
+    import time
+
+    # Thread OBJECTS, not idents: CPython reuses a dead thread's ident,
+    # so an ident snapshot could silently exclude a genuine leak.
+    before = set(threading.enumerate())
+    yield
+
+    def strays():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon
+                and t not in before
+                and t is not threading.current_thread()
+                and not any(t.name.startswith(p)
+                            for p in _THREAD_ALLOWLIST_PREFIXES)]
+
+    # Grace window: teardown that signalled its threads deserves one
+    # scheduler beat to see them unwind before the verdict.
+    leaked = strays()
+    deadline = time.monotonic() + 2.0
+    while leaked and time.monotonic() < deadline:
+        for t in leaked:
+            t.join(timeout=0.2)
+        leaked = strays()
+    assert not leaked, (
+        f"test leaked non-daemon thread(s): "
+        f"{[t.name for t in leaked]} — join them on a teardown path, "
+        f"or extend the conftest allowlist with an audited reason")

@@ -275,6 +275,7 @@ class TestReportAliasing:
         ("serve", profiler.serve_report, profiler.reset_serve_records),
         ("analysis", profiler.analysis_report,
          profiler.reset_analysis_records),
+        ("locks", profiler.lock_report, profiler.reset_lock_records),
     ])
     def test_mutating_report_does_not_poison_store(self, kind, report,
                                                    reset):

@@ -49,10 +49,13 @@ import importlib
 from typing import Any
 
 __all__ = [
-    "AnalysisReport", "CollectiveEqn", "Expected", "Finding",
-    "SCALAR_NBYTES", "Waiver", "analyze_accum_step", "analyze_jaxpr",
+    "AnalysisReport", "CollectiveEqn", "ConcFinding", "ConcReport",
+    "Expected", "Finding",
+    "SCALAR_NBYTES", "Waiver", "WitnessLock", "analyze_accum_step",
+    "analyze_concurrency", "analyze_jaxpr",
     "analyze_serve_step",
-    "apply_waivers", "check_signature", "collect_collectives",
+    "apply_waivers", "check_lock_order", "check_signature",
+    "collect_collectives",
     "diff_signature", "expected_accum_collectives", "live_high_water",
     "step_signature",
 ]
@@ -68,7 +71,13 @@ _LAZY = {
     "expected_accum_collectives": "rules",
     "check_signature": "signature", "diff_signature": "signature",
     "step_signature": "signature",
-    "cli": None, "core": None, "jaxprwalk": None, "rules": None,
+    # The concurrency plane is jax-free like srclint — the facade keeps
+    # it importable from `make lint` / gateway hosts without jax.
+    "ConcFinding": "concurrency", "ConcReport": "concurrency",
+    "WitnessLock": "concurrency", "analyze_concurrency": "concurrency",
+    "check_lock_order": "concurrency",
+    "cli": None, "concurrency": None, "core": None, "jaxprwalk": None,
+    "rules": None,
     "signature": None, "srclint": None,
 }
 

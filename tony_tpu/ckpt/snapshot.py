@@ -205,6 +205,7 @@ class AsyncCheckpointer:
         self.barrier_timeout_s = barrier_timeout_s
         self._slots = threading.BoundedSemaphore(max(1, buffers))
         self._q: "queue.Queue[Optional[Snapshot]]" = queue.Queue()
+        self._err_lock = threading.Lock()    # guards _err (writer/caller)
         self._err: Optional[BaseException] = None
         self._closed = False
         self.stats: Dict[str, Any] = {
@@ -240,15 +241,20 @@ class AsyncCheckpointer:
                         nbytes=snap.nbytes, n_chunks=len(snap.chunks),
                         keep=self.keep)
             except BaseException as e:  # noqa: BLE001 — surfaced on save/wait
-                self._err = e
+                with self._err_lock:
+                    self._err = e
             finally:
                 snap.done.set()
                 self._slots.release()
                 self._q.task_done()
 
     def _raise_pending(self) -> None:
-        if self._err is not None:
+        # Swap under the lock: an unlocked read-then-clear could
+        # overwrite (and lose) an error the writer banked between the
+        # two — the concurrency audit's torn read-modify-write case.
+        with self._err_lock:
             err, self._err = self._err, None
+        if err is not None:
             raise RuntimeError("checkpoint writer failed") from err
 
     # -- caller side -------------------------------------------------------

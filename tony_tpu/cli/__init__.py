@@ -510,6 +510,11 @@ def make_parser() -> argparse.ArgumentParser:
     an.add_argument("--lint", action="store_true",
                     help="also run the jnp.concatenate/stack pack-site "
                          "source lint (make lint)")
+    an.add_argument("--concurrency", action="store_true",
+                    help="run the host-side concurrency plane instead "
+                         "of the jaxpr configs: lock-discipline lint, "
+                         "lock-order deadlock check (static + witness), "
+                         "thread-hygiene audit — jax-free")
     an.set_defaults(fn=cmd_analyze)
 
     v = sub.add_parser("version", help="print version")

@@ -18,6 +18,12 @@ pipeline state taken AFTER producing it; :meth:`DeviceIterator.state`
 returns the state of the last batch DELIVERED to the caller, never the
 producer's read-ahead position — a checkpoint taken between steps resumes
 exactly at the next undelivered example, regardless of depth.
+
+Thread hygiene (audited by ``tony_tpu.analysis.concurrency``): the
+producer is daemon AND joined — daemon so an abandoned iterator can
+never pin the interpreter, joined (``close()``, bounded) so the normal
+teardown path is deterministic rather than relying on interpreter exit;
+the weakref dance below covers the abandoned case in between.
 """
 
 from __future__ import annotations

@@ -174,8 +174,16 @@ class TaskMonitor:
             except Exception:
                 backoff = min(60.0, max(self.interval_s, backoff * 2))
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 2.0) -> None:
+        """Signal and JOIN (bounded): the monitor shares the executor's
+        RPC client, and teardown closing that client under a mid-call
+        sampler was a race, not a shutdown. The monitor's own RPC window
+        is short; a stuck call is abandoned at the timeout rather than
+        wedging executor exit."""
         self._stop.set()
+        if self._thread.is_alive() \
+                and self._thread is not threading.current_thread():
+            self._thread.join(timeout=join_timeout_s)
 
 
 class TaskExecutor:
@@ -581,6 +589,12 @@ class TaskExecutor:
             return exit_code
         finally:
             self._hb_stop.set()
+            # Bounded join so teardown is deterministic, not
+            # daemon-abandoned: the loop's own RPC window is short
+            # (timeout = heartbeat interval), so a live thread exits
+            # within one wait tick; a wedged one is abandoned rather
+            # than blocking executor exit.
+            hb_thread.join(timeout=5.0)
             for s in (rendezvous_sock, tb_sock, prof_sock):
                 if s is not None:
                     try:

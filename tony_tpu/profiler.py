@@ -283,6 +283,35 @@ def reset_analysis_records() -> None:
     ANALYSIS_RECORDS.clear()
 
 
+# ---------------------------------------------------------------------------
+# Lock-witness instrumentation (tony_tpu.analysis.concurrency): the runtime
+# witness banks the process-global observed lock-order graph — every (held,
+# acquired) edge any thread produced through an instrumented
+# Lock/RLock/Condition, with counts, thread names, and first-observation
+# sites — under tag "witness" (re-banked whenever a NEW edge appears), and
+# the concurrency lint banks its summary next to the jaxpr analyzer's in
+# analysis_report(). Cycle detection over this graph merged with the static
+# nested-`with` graph is what turns a potential deadlock into a named
+# finding instead of a hung CI job.
+LOCK_RECORDS: Dict[str, Dict[str, object]] = {}
+
+
+def record_locks(tag: str, /, **fields) -> None:
+    """Bank one lock-witness record (instrumented lock names, observed
+    acquisition-order edges with counts/threads/sites...)."""
+    LOCK_RECORDS[tag] = dict(fields)
+
+
+def lock_report() -> Dict[str, Dict[str, object]]:
+    """Snapshot of every recorded lock-witness entry (deep-copied via
+    :func:`_snapshot` — same aliasing contract as the other reports)."""
+    return _snapshot(LOCK_RECORDS)
+
+
+def reset_lock_records() -> None:
+    LOCK_RECORDS.clear()
+
+
 # One guarded entry point for the trace-side recorders (overlap grad sync,
 # ckpt snapshot, input prefetch): bookkeeping must never sink a step or a
 # save, and a broken wiring is logged once per registry at DEBUG — not per
@@ -293,12 +322,13 @@ _SAFE_RECORD_FAILED: set = set()
 def safe_record(kind: str, tag: str, /, **fields) -> None:
     """Record into the ``kind`` registry (``"overlap"``/``"ckpt"``/
     ``"input"``/``"collective"``/``"update"``/``"quant"``/
-    ``"serve"``/``"analysis"``), swallowing any failure."""
+    ``"serve"``/``"analysis"``/``"locks"``), swallowing any failure."""
     try:
         {"overlap": record_overlap, "ckpt": record_ckpt,
          "input": record_input, "collective": record_collective,
          "update": record_update, "quant": record_quant,
-         "serve": record_serve, "analysis": record_analysis}[kind](
+         "serve": record_serve, "analysis": record_analysis,
+         "locks": record_locks}[kind](
              tag, **fields)
     except Exception:  # noqa: BLE001
         if kind not in _SAFE_RECORD_FAILED:
@@ -325,8 +355,21 @@ def _trace_fn():
         from tensorflow.python.profiler import profiler_client
 
         def capture(addr: str, logdir: str, duration_ms: int) -> None:
+            # TF >= 2.16 requires a ProfilerOptions namedtuple (it calls
+            # options._asdict()); a plain dict dies inside the client
+            # with "'dict' object has no attribute '_asdict'" — measured
+            # on this image's TF 2.20, where it broke every capture.
+            options: object = _TRACE_OPTIONS
+            try:
+                from tensorflow.python.profiler.profiler_v2 import (
+                    ProfilerOptions)
+                options = ProfilerOptions(**{
+                    k: v for k, v in _TRACE_OPTIONS.items()
+                    if k in ProfilerOptions._fields})
+            except ImportError:
+                pass
             profiler_client.trace(f"grpc://{addr}", logdir, duration_ms,
-                                  options=_TRACE_OPTIONS)
+                                  options=options)
 
         return capture
     except ImportError:

@@ -151,7 +151,8 @@ class RpcClient:
         return timeout + 2.0 * cls._per_op(timeout)
 
     def _connect(self, per_op: Optional[float] = None) -> None:
-        self.close()
+        """(Re)dial. Caller holds ``self._lock`` (``call`` does)."""
+        self._close_locked()
         if per_op is None:
             per_op = self._per_op(self.timeout)
         self._sock = socket.create_connection(self._addr, timeout=per_op)
@@ -205,13 +206,14 @@ class RpcClient:
             except (OSError, ValueError, ConnectionError) as e:
                 last_err = e
                 with self._lock:
-                    self.close()
+                    self._close_locked()
                 time.sleep(self.retry_interval)
         raise ConnectionError(
             f"RPC {method} to {self._addr} failed after {effective}s: "
             f"{last_err}")
 
-    def close(self) -> None:
+    def _close_locked(self) -> None:
+        """Tear down the connection. Caller holds ``self._lock``."""
         if self._file is not None:
             try:
                 self._file.close()
@@ -224,6 +226,16 @@ class RpcClient:
             except OSError:
                 pass
             self._sock = None
+
+    def close(self) -> None:
+        # Under the lock: teardown (executor finally, __exit__) races a
+        # sharer mid-call — the TaskMonitor thread and the executor main
+        # thread share one client — and nulling _file under a writer was
+        # an AttributeError crash, not a clean ConnectionError retry
+        # (found by the concurrency audit; call() already serializes all
+        # connection use on this lock).
+        with self._lock:
+            self._close_locked()
 
     def __enter__(self) -> "RpcClient":
         return self

@@ -569,8 +569,13 @@ class ServeEngine(PagedModelRunner):
     def _evict(self, seq: _Seq, results: List[Completion]) -> None:
         self.cache.free_seq(seq.rid)
         now = time.monotonic()
-        self._events.append((now, now - seq.t_submit,
-                             len(seq.tokens) - seq.n_prompt))
+        # Under the lock: the stats publisher thread (replica heartbeat)
+        # iterates this ring concurrently with the drive thread, and a
+        # deque mutated mid-iteration raises — found by the concurrency
+        # lint's guarded-elsewhere rule, pinned by test_concurrency.
+        with self._lock:
+            self._events.append((now, now - seq.t_submit,
+                                 len(seq.tokens) - seq.n_prompt))
         self._completed += 1
         self._tokens_out += len(seq.tokens) - seq.n_prompt
         results.append(Completion(
@@ -671,7 +676,9 @@ class ServeEngine(PagedModelRunner):
         can actually fire; ``completed``/``steps``/``forwards`` stay
         lifetime counters."""
         now = time.monotonic()
-        recent = [(l, n) for t, l, n in self._events
+        with self._lock:
+            events = list(self._events)
+        recent = [(l, n) for t, l, n in events
                   if now - t <= self.stats_window_s]
         lat = sorted(l for l, _ in recent)
         dt = max(1e-9, min(self.stats_window_s, now - self._t0))

@@ -44,6 +44,17 @@ Capacity failures are a typed :class:`AdmissionError` carrying the
 needed/free block counts — an admission-control signal the engine (or a
 load balancer above it) can act on, categorically different from an
 allocator OOM.
+
+Threading contract: the allocator is NOT internally locked. All
+bookkeeping mutation is driven by the engine's single drive thread
+(``EngineFront`` serializes concurrent ``generate`` callers on its drive
+lock before any of them steps the engine); an external caller sharing a
+pool across threads must bring its own mutual exclusion. The
+concurrency-analysis plane (``tony_tpu.analysis.concurrency``) audits
+that discipline, and the threaded kvcache stress in
+``tests/test_concurrency.py`` drives this class from N threads through
+witnessed locks with the refcount/free/LRU partition pinned at every
+quiescent point.
 """
 
 from __future__ import annotations

@@ -179,20 +179,34 @@ class Replica:
         print(f"[tony-serve-replica] listening on {server.address} "
               f"(ckpt step {self.restored_step})", flush=True)
         stop = stop or threading.Event()
+
+        def publish() -> None:
+            if not stats_path:
+                return
+            try:
+                # rpc_port rides the stats file → heartbeat →
+                # session so the request router can DIAL this
+                # replica (task.port is the rendezvous port,
+                # not the serve RPC) — and the prefix digest
+                # rides the same payload for overlap scoring.
+                self.engine.write_stats(
+                    stats_path, extra={"rpc_port": server.port})
+            except OSError:
+                pass
+
         try:
+            # First publish BEFORE the first interval: the router can
+            # only dial a replica whose rpc_port reached the AM, and a
+            # freshly-granted scale-up that waits a full publish tick
+            # to become routable pays that tick as cold-start latency.
+            publish()
             while not stop.wait(stats_every_s):
-                if stats_path:
-                    try:
-                        # rpc_port rides the stats file → heartbeat →
-                        # session so the request router can DIAL this
-                        # replica (task.port is the rendezvous port,
-                        # not the serve RPC) — and the prefix digest
-                        # rides the same payload for overlap scoring.
-                        self.engine.write_stats(
-                            stats_path, extra={"rpc_port": server.port})
-                    except OSError:
-                        pass
+                publish()
         finally:
+            # Deterministic teardown (the concurrency plane's shutdown-
+            # hygiene contract): server.stop() joins the accept thread,
+            # so by the time serve_forever returns no replica thread is
+            # left running.
             server.stop()
 
 

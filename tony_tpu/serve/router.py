@@ -375,6 +375,7 @@ class RouterServer:
         self._server = RpcServer(RouterRpcHandler(router), host=host,
                                  port=port)
         self._stop = threading.Event()
+        self._stop_lock = threading.Lock()   # guards the stop transition
         self._poller: Optional[threading.Thread] = None
 
     @property
@@ -388,10 +389,15 @@ class RouterServer:
     def start(self) -> "RouterServer":
         self._server.start()
         if self.am_address:
-            self._poller = threading.Thread(target=self._poll_loop,
-                                            name="tony-router-poll",
-                                            daemon=True)
-            self._poller.start()
+            # Under the stop lock (the concurrency lint holds this
+            # module to its own discipline): a stop() overlapping
+            # start() must either see no poller or the whole one — a
+            # half-published thread would be joined never.
+            with self._stop_lock:
+                self._poller = threading.Thread(target=self._poll_loop,
+                                                name="tony-router-poll",
+                                                daemon=True)
+                self._poller.start()
         return self
 
     def _poll_loop(self) -> None:
@@ -406,10 +412,24 @@ class RouterServer:
                 pass
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._poller is not None:
-            self._poller.join(timeout=2)
+        """Deterministic teardown: stop the poller and JOIN it, then
+        stop the RPC server (which joins its accept thread). Idempotent
+        AND race-free — teardown paths (context exit, CLI finally,
+        tests) may overlap, and the loser of the atomic test-and-set
+        must no-op rather than shutdown() a closed server or join a
+        poller the winner already cleared."""
+        with self._stop_lock:
+            if self._stop.is_set():
+                return
+            self._stop.set()
+            poller, self._poller = self._poller, None
+        if poller is not None:
+            poller.join(timeout=2)
         self._server.stop()
+
+    # The explicit-close spelling the shutdown-hygiene audit asks every
+    # thread-owning front to have (DeviceIterator.close, RpcClient.close).
+    close = stop
 
     def __enter__(self) -> "RouterServer":
         return self.start()
