@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -112,6 +112,20 @@ tier1-route:
 # budget, but this named leg is the lane's gate and must see them.
 tier1-conc:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m conc -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Disaggregated-serving marker leg — the KV-block wire tier (export/
+# import with per-block CRC, adoption of shipped shared-prefix stems,
+# state-unchanged typed rejections), the prefill-only engine mode, the
+# BITWISE disagg-vs-colocated pins (ragged lengths, hit/miss
+# admissions, spec lane on the decode side), bounded retry/backoff with
+# the router's colocated fallback and the OSError-vs-request-error
+# failover split, the widened role+handoff heartbeat schema, and the
+# ninth analyze config. Runs the FULL disagg selection (slow included):
+# the RPC fleet e2e and long-prompt handoff tests are slow-marked to
+# keep tier1-verify inside its (tight — ROADMAP) 870 s budget, but this
+# named leg is the lane's gate and must see them.
+tier1-disagg:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m disagg -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Source lints, machine-checked: (1) the jnp.concatenate/stack pack-site
 # lint (the jax-0.4 GSPMD concat-reshard footgun) — every call site

@@ -280,6 +280,21 @@ def main() -> int:
             result["route_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
 
+    if os.environ.get("BENCH_DISAGG", "1") != "0":
+        # Disaggregated prefill/decode leg (tony_tpu.serve.disagg,
+        # PR 15): a decode floor absorbing a prefill burst, colocated
+        # chunked vs the split gang with KV-block handoff — decode p99
+        # isolation is the headline, the decode side's ZERO prefill
+        # launches and the launch split are the machine-independent
+        # claims, token identity gated in both configurations. CPU wall
+        # numbers measure scheduling (disagg_sim_note); BENCH_r15.
+        try:
+            from tony_tpu.benchmark import run_disagg_bench
+            result.update(run_disagg_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["disagg_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

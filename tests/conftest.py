@@ -51,6 +51,7 @@ _TIER_BY_MODULE = {
     "test_serve": "jit",
     "test_spec": "jit",
     "test_route": "jit",
+    "test_disagg": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

@@ -757,7 +757,8 @@ class TestThreadedKvcacheInterleave:
         stats = {"ops": 0, "admitted": 0}
 
         def one_op(rng, tid, seqs, sid_n):
-            op = rng.choice(["admit", "write", "spec", "free"])
+            op = rng.choice(["admit", "write", "spec", "free",
+                             "handoff"])
             if op == "admit":
                 sid = f"t{tid}-s{sid_n[0]}"
                 sid_n[0] += 1
@@ -800,6 +801,58 @@ class TestThreadedKvcacheInterleave:
                 del seqs[sid]
                 c.free_seq(sid)
                 assert c.free_seq(sid) == 0
+            elif op == "handoff" and seqs:
+                # The PR 15 wire tier under the same witnessed lock:
+                # export a live sequence's prompt blocks and import
+                # them as a new sequence — the self-handoff exercises
+                # the receiver path (offer-matched adoption + fresh
+                # byte writes) exactly as an RPC receiver thread would
+                # drive it, and the partition stays pinned.
+                from tony_tpu.serve import HandoffError
+
+                src = list(seqs)[rng.randint(len(seqs))]
+                toks = seqs[src]
+                bs = c.block_size
+                exp_len = rng.randint(1, len(toks) + 1)
+                blocks = c.export_blocks(src, exp_len)
+                keys = _keys(toks)[:exp_len // bs]
+                offset = len(c.match_prefix(keys))
+                sid = f"t{tid}-h{sid_n[0]}"
+                sid_n[0] += 1
+                if blocks[offset:] and rng.rand() < 0.25:
+                    # Seeded corruption: the import must reject typed
+                    # and state-unchanged (the partition check below
+                    # pins "unchanged").
+                    bad = [dict(b) for b in blocks[offset:]]
+                    bad[0]["crc"] ^= 1
+                    try:
+                        c.import_blocks(sid, exp_len, bad, keys=keys,
+                                        offset=offset)
+                        raise AssertionError("corrupt import accepted")
+                    except HandoffError:
+                        return
+                try:
+                    adopted = c.import_blocks(
+                        sid, exp_len + 4, blocks[offset:], keys=keys,
+                        offset=offset)
+                except AdmissionError:
+                    return
+                assert adopted == offset
+                # Imported bytes are read-only until the engine's write
+                # path COWs them: adopted blocks stay referenced (>= 2
+                # with a live donor, 1 when revived from the cached
+                # tier), fresh imports privately owned — and the write
+                # op's exclusivity assert above covers the COW half.
+                t_new = c.table(sid)
+                for b in t_new[:adopted]:
+                    assert c.ref(b) >= 1
+                if blocks[offset:]:
+                    i = adopted + rng.randint(len(blocks) - offset)
+                    want_k, _ = c._decode_block(blocks[i])
+                    assert np.array_equal(
+                        np.asarray(c.k[:, t_new[i]]), want_k), \
+                        "imported block bytes must land verbatim"
+                seqs[sid] = list(toks[:exp_len])
 
         def worker(tid):
             rng = np.random.RandomState(100 + tid)
@@ -836,6 +889,8 @@ class TestThreadedKvcacheInterleave:
         assert c.free_blocks == c.n_blocks
         assert c.adopted_total > 0 and c.cow_total > 0, \
             "the interleave must actually exercise sharing and COW"
+        assert c.imported_total > 0, \
+            "the interleave must actually exercise the handoff wire tier"
         assert stats["ops"] == self.N_THREADS * self.ROUNDS \
             * self.OPS_PER_ROUND
         # The witness watched the whole run: the pool->stats edge was

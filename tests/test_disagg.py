@@ -1,0 +1,1051 @@
+"""Disaggregated prefill/decode legs (tony_tpu.serve.disagg, PR 15):
+the KV-block wire tier (export/import with per-block CRC, adoption of
+shipped shared-prefix stems), the prefill-only engine mode, the
+decode-side handoff admission, the role-aware router dispatch with its
+OSError-vs-HandoffError failover split, the widened heartbeat schema
+(role + handoff counters), and the BITWISE pins of every disaggregated
+path against the colocated PR 10/12/13 engine."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.disagg
+
+
+# ---------------------------------------------------------------------------
+# Shared tiny model + params (serving is read-only on params).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    import flax.linen as nn
+
+    from tony_tpu.models import get_model
+
+    model = get_model("llama-tiny", n_layers=2)
+    sample = jnp.zeros((1, 16), jnp.int32)
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), sample))["params"]
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        params)
+    return model, params
+
+
+def make_engine(tiny, **kw):
+    from tony_tpu.serve import ServeEngine
+
+    model, params = tiny
+    kw.setdefault("ctx_max", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("q_block", 16)
+    kw.setdefault("decode_buckets", (2, 4))
+    kw.setdefault("max_running", 4)
+    kw.setdefault("keep_logits", True)
+    return ServeEngine(model, params, **kw)
+
+
+def run_requests(eng, prompts, max_new=4):
+    from tony_tpu.serve import Request
+
+    done = {}
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, tokens=list(p), max_new_tokens=max_new))
+    done.update({c.rid: c for c in eng.run()})
+    return done
+
+
+def disagg_requests(tiny, prompts, max_new=4, *, prefill_kw=None,
+                    decode_kw=None, spec_k=0):
+    """Prefill engine -> KV handoff -> decode engine, per request;
+    returns (completions, prefill_engine, decode_engine)."""
+    from tony_tpu.serve import EngineFront, SpecEngine
+    from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+    pf_eng = make_engine(tiny, role="prefill", **(prefill_kw or {}))
+    if spec_k:
+        model, params = tiny
+        dc_eng = SpecEngine(model, params, spec_k=spec_k, role="decode",
+                            ctx_max=64, block_size=8, q_block=16,
+                            decode_buckets=(2, 4), max_running=4,
+                            keep_logits=True, **(decode_kw or {}))
+    else:
+        dc_eng = make_engine(tiny, role="decode", **(decode_kw or {}))
+    pf = PrefillFront(EngineFront(pf_eng))
+    dc = DecodeFront(EngineFront(dc_eng))
+    done = {i: pf.prefill_handoff(p, max_new, rid=i, decode=dc)
+            for i, p in enumerate(prompts)}
+    return done, pf_eng, dc_eng
+
+
+def assert_bitwise_equal(got, ref):
+    """Token streams AND per-token logits of two completion maps."""
+    assert sorted(got) == sorted(ref)
+    for rid in ref:
+        assert got[rid].tokens == ref[rid].tokens, rid
+        assert len(got[rid].logits) == len(ref[rid].logits)
+        for a, b in zip(got[rid].logits, ref[rid].logits):
+            assert np.array_equal(a, b), rid
+
+
+def cache_snapshot(c):
+    return (dict(c._refs), list(c._free), c.cached_blocks(),
+            {s: list(t) for s, t in c.owned_blocks().items()})
+
+
+# ---------------------------------------------------------------------------
+# The KV wire tier (kvcache export/import)
+# ---------------------------------------------------------------------------
+
+class TestWireTier:
+    def _pool(self, n_blocks=8, block_size=4):
+        from tony_tpu.serve import PagedKVCache
+
+        return PagedKVCache(2, 4, n_blocks=n_blocks,
+                            block_size=block_size)
+
+    def _fill(self, c, sid, length):
+        """Reserve + write recognizable bytes for ``length`` positions."""
+        c.reserve(sid, length)
+        for b in c.table(sid):
+            c.k = c.k.at[:, b].set(float(b + 1))
+            c.v = c.v.at[:, b].set(float(-(b + 1)))
+        return c.table(sid)
+
+    def test_export_import_round_trips_bytes(self):
+        from tony_tpu.serve import prefix
+
+        src = self._pool()
+        self._fill(src, "s", 7)
+        blocks = src.export_blocks("s", 7)
+        assert len(blocks) == 2 and all("crc" in b for b in blocks)
+        dst = self._pool()
+        keys = prefix.chain_keys(list(range(7)), 4)
+        adopted = dst.import_blocks("d", 11, blocks, keys=keys, offset=0)
+        assert adopted == 0 and dst.imported_total == 2
+        # Bytes land verbatim, position for position.
+        st, dt = src.table("s"), dst.table("d")
+        for i in range(2):
+            assert np.array_equal(np.asarray(src.k[:, st[i]]),
+                                  np.asarray(dst.k[:, dt[i]]))
+            assert np.array_equal(np.asarray(src.v[:, st[i]]),
+                                  np.asarray(dst.v[:, dt[i]]))
+        assert len(dt) == dst.blocks_for(11)
+
+    def test_corrupt_crc_is_typed_and_state_unchanged(self):
+        from tony_tpu.serve import HandoffError
+
+        src = self._pool()
+        self._fill(src, "s", 8)
+        blocks = src.export_blocks("s", 8)
+        blocks[1] = dict(blocks[1], crc=(blocks[1]["crc"] ^ 1))
+        dst = self._pool()
+        snap = cache_snapshot(dst)
+        k0, v0 = dst.k, dst.v
+        with pytest.raises(HandoffError) as ei:
+            dst.import_blocks("d", 8, blocks)
+        assert not ei.value.retryable
+        assert cache_snapshot(dst) == snap
+        # Device bytes untouched too — validation runs before any write.
+        assert dst.k is k0 and dst.v is v0
+
+    def test_pool_pressure_is_admission_error_state_unchanged(self):
+        from tony_tpu.serve import AdmissionError
+
+        src = self._pool()
+        self._fill(src, "s", 8)
+        blocks = src.export_blocks("s", 8)
+        dst = self._pool(n_blocks=4)
+        dst.reserve("hog", 12)          # 3 of 4 blocks
+        snap = cache_snapshot(dst)
+        with pytest.raises(AdmissionError) as ei:
+            dst.import_blocks("d", 8, blocks)
+        assert ei.value.retryable
+        assert cache_snapshot(dst) == snap
+        dst.free_seq("hog")
+        assert dst.import_blocks("d", 8, blocks) == 0   # heals
+
+    def test_import_adopts_offered_stem_not_rewritten(self):
+        from tony_tpu.serve import prefix
+
+        stem = list(range(8))           # 2 full blocks of 4
+        keys = prefix.chain_keys(stem, 4)
+        src = self._pool()
+        self._fill(src, "s", 10)
+        blocks = src.export_blocks("s", 10)
+        dst = self._pool()
+        # Publish the stem on the receiving pool (an earlier handoff).
+        dst.import_blocks("prior", 8, blocks[:2])
+        for i, key in enumerate(keys):
+            dst.publish_block("prior", i, key)
+        imported_before = dst.imported_total
+        # The offer/import handshake: offset = receiver's match.
+        offset = len(dst.match_prefix(keys))
+        assert offset == 2
+        adopted = dst.import_blocks("d", 12, blocks[offset:], keys=keys,
+                                    offset=offset)
+        assert adopted == 2
+        assert dst.imported_total - imported_before == 1   # only the tail
+        # The adopted blocks are SHARED with the prior holder — and the
+        # COW contract keeps them read-only for the importer.
+        t_prior, t_d = dst.table("prior"), dst.table("d")
+        assert t_d[:2] == t_prior[:2]
+        assert all(dst.ref(b) == 2 for b in t_d[:2])
+        w = dst.write_index("d", 0)     # write into an adopted block
+        assert dst.table("d")[0] != t_prior[0], "COW must repoint"
+        assert dst.ref(t_prior[0]) == 1
+
+    def test_evaporated_offer_is_retryable_with_matched_count(self):
+        from tony_tpu.serve import HandoffError, prefix
+
+        src = self._pool()
+        self._fill(src, "s", 8)
+        blocks = src.export_blocks("s", 8)
+        keys = prefix.chain_keys(list(range(8)), 4)
+        dst = self._pool()
+        snap = cache_snapshot(dst)
+        with pytest.raises(HandoffError) as ei:
+            dst.import_blocks("d", 8, blocks[2:], keys=keys, offset=2)
+        assert ei.value.retryable and ei.value.matched == 0
+        assert cache_snapshot(dst) == snap
+
+    def test_geometry_mismatch_is_non_retryable(self):
+        from tony_tpu.serve import HandoffError, PagedKVCache
+
+        src = self._pool()
+        self._fill(src, "s", 4)
+        blocks = src.export_blocks("s", 4)
+        dst = PagedKVCache(2, 8, n_blocks=8, block_size=4)  # wider kv
+        with pytest.raises(HandoffError) as ei:
+            dst.import_blocks("d", 4, blocks)
+        assert not ei.value.retryable
+        assert src.wire_header() != dst.wire_header()
+
+    def test_shipper_bounded_retry_reships_missing_tail(self):
+        """The offer/import handshake under churn: the receiver's match
+        shrinks between offer and import; the shipper re-ships exactly
+        the missing tail (the HandoffError's matched count), bounded."""
+        from tony_tpu.serve import HandoffError, KVShipper
+
+        calls = []
+
+        class FlakyDecode:
+            def kv_offer(self, keys):
+                return 2                      # stale promise
+
+            def kv_import(self, payload):
+                calls.append((payload["offset"], len(payload["blocks"])))
+                if len(calls) == 1:
+                    raise HandoffError("evaporated", matched=1)
+                return {"rid": payload.get("rid"), "tokens": [1]}
+
+        handoff = {"keys": ["a", "b", "c"],
+                   "blocks": [{"n": i} for i in range(3)]}
+        out, shipped = KVShipper(max_attempts=3, backoff_s=0.0).ship(
+            handoff, FlakyDecode())
+        assert out["tokens"] == [1]
+        assert shipped == 2                   # the final attempt's wire
+        assert calls == [(2, 1), (1, 2)]      # re-shipped the lost block
+
+        class AlwaysFull:
+            def kv_offer(self, keys):
+                return 0
+
+            def kv_import(self, payload):
+                raise HandoffError("pool full")
+
+        with pytest.raises(HandoffError) as ei:
+            KVShipper(max_attempts=3, backoff_s=0.0).ship(
+                handoff, AlwaysFull())
+        assert not ei.value.retryable
+        assert "after 3 attempt(s)" in str(ei.value)
+
+        class Corrupt:
+            def kv_offer(self, keys):
+                return 0
+
+            def kv_import(self, payload):
+                raise HandoffError("crc mismatch", retryable=False)
+
+        with pytest.raises(HandoffError) as ei:
+            KVShipper(max_attempts=3, backoff_s=0.0).ship(
+                handoff, Corrupt())
+        assert "after 1 attempt(s)" in str(ei.value), \
+            "a non-retryable break must report the REAL attempt count"
+
+
+# ---------------------------------------------------------------------------
+# Engine-level bitwise pins vs the colocated engine
+# ---------------------------------------------------------------------------
+
+class TestDisaggBitwise:
+    def test_ragged_lengths_bitwise_vs_colocated(self, tiny):
+        """Prompt lengths spanning block boundaries (7/8/9/15/17):
+        token streams AND per-token logits identical to the colocated
+        engine's — the handoff's device->wire->device round trip is
+        lossless and the decode resumes exactly where a colocated
+        prefill would."""
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(0, 256, n)) for n in (7, 8, 9, 15, 17)]
+        ref = run_requests(make_engine(tiny), prompts, max_new=5)
+        got, pf_eng, dc_eng = disagg_requests(tiny, prompts, max_new=5)
+        assert_bitwise_equal(got, ref)
+        assert dc_eng.handoffs_in == len(prompts)
+        assert dc_eng.cache.imported_total > 0
+        # Both pools drain: the prefill gang frees at export, decode at
+        # eviction — a leak would starve the fleet under load.
+        assert pf_eng.cache.free_blocks == pf_eng.cache.n_blocks
+        assert dc_eng.cache.free_blocks == dc_eng.cache.n_blocks
+
+    def test_chunked_prefill_family_bitwise(self, tiny):
+        """The prefill side runs the chunked (1, chunk) launch family —
+        the same program the route config pins — and the split point
+        cannot change a bit."""
+        rng = np.random.RandomState(1)
+        prompts = [list(rng.randint(0, 256, n)) for n in (9, 17, 33)]
+        ref = run_requests(make_engine(tiny), prompts, max_new=4)
+        got, pf_eng, _ = disagg_requests(
+            tiny, prompts, max_new=4, prefill_kw={"prefill_chunk": 16})
+        assert_bitwise_equal(got, ref)
+        assert pf_eng.prefill_chunks >= 4
+
+    def test_hit_and_miss_admissions_bitwise(self, tiny):
+        """Prefix caching armed on BOTH sides: the prefill gang adopts
+        published stems (hits skip prefill launches), the decode pool
+        adopts the shipped stem instead of re-importing it — and the
+        shipper provably re-transfers nothing for the adopted extent."""
+        rng = np.random.RandomState(2)
+        stem = list(rng.randint(0, 256, 16))    # 2 full blocks of 8
+        prompts = [stem + list(rng.randint(0, 256, 5)),
+                   stem + list(rng.randint(0, 256, 9)),
+                   list(rng.randint(0, 256, 11)),   # miss
+                   stem[:8] + list(rng.randint(0, 256, 3))]
+        ref = run_requests(make_engine(tiny), prompts, max_new=5)
+        got, pf_eng, dc_eng = disagg_requests(
+            tiny, prompts, max_new=5,
+            prefill_kw={"prefix_cache": True},
+            decode_kw={"prefix_cache": True})
+        assert_bitwise_equal(got, ref)
+        assert pf_eng.prefix_hit_blocks > 0, "prefill-side hits"
+        assert dc_eng.cache.adopted_total > 0, "decode-side adoption"
+        # Shipped strictly fewer blocks than the prompts cover: the
+        # stem crossed the wire once, later requests offered it away.
+        covered = sum(pf_eng.cache.blocks_for(len(p)) for p in prompts)
+        assert pf_eng.blocks_shipped < covered
+
+    def test_spec_lane_on_decode_side_bitwise(self, tiny):
+        """The speculative lane rides the decode side of the split:
+        draft-and-verify over imported KV, greedy outputs pinned to the
+        plain colocated engine's."""
+        rng = np.random.RandomState(3)
+        prompts = [list(rng.randint(0, 256, n)) for n in (7, 12, 17)]
+        ref = run_requests(make_engine(tiny), prompts, max_new=6)
+        got, _, dc_eng = disagg_requests(tiny, prompts, max_new=6,
+                                         spec_k=4)
+        assert_bitwise_equal(got, ref)
+        assert dc_eng.verify_launches > 0
+        assert dc_eng.cache.free_blocks == dc_eng.cache.n_blocks
+
+    def test_mismatched_chain_keys_reject_before_poisoning_index(self, tiny):
+        """The shipped keys index imported blocks into the SHARED
+        prefix tier — a key-scheme-skewed shipper must reject typed
+        and state-unchanged, not silently poison future adoptions."""
+        from tony_tpu.serve import EngineFront, HandoffError
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+        from tony_tpu.serve.engine import Request
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode", prefix_cache=True)
+        pf = PrefillFront(EngineFront(pf_eng))
+        dc = DecodeFront(EngineFront(dc_eng))
+        rng = np.random.RandomState(14)
+        p = list(rng.randint(0, 256, 17))
+        with pf.front._drive:
+            payload = pf_eng.prefill_only(
+                Request(rid="r", tokens=p, max_new_tokens=4))
+        payload["keys"] = ["deadbeef" * 2] * len(payload["keys"])
+        snap = cache_snapshot(dc_eng.cache)
+        with pytest.raises(HandoffError) as ei:
+            dc.kv_import(payload)
+        assert not ei.value.retryable
+        assert cache_snapshot(dc_eng.cache) == snap
+        assert dc_eng.cache.match_prefix(payload["keys"]) == [], \
+            "nothing may have been indexed under the bogus keys"
+
+    def test_corrupt_logits_rejects_typed_and_state_unchanged(self, tiny):
+        """logits_b64 rides outside the per-block CRC: a corrupt row
+        must reject BEFORE the import mutates the pool — no leaked
+        table, imports_failed counted, typed error."""
+        from tony_tpu.serve import EngineFront, HandoffError
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode")
+        pf = PrefillFront(EngineFront(pf_eng))
+        dc = DecodeFront(EngineFront(dc_eng))
+        rng = np.random.RandomState(12)
+        p = list(rng.randint(0, 256, 9))
+        from tony_tpu.serve.engine import Request
+
+        with pf.front._drive:
+            payload = pf_eng.prefill_only(
+                Request(rid="r", tokens=p, max_new_tokens=4))
+        payload["logits_b64"] = payload["logits_b64"][:-3]   # corrupt
+        snap = cache_snapshot(dc_eng.cache)
+        with pytest.raises(HandoffError) as ei:
+            dc.kv_import(payload)
+        assert not ei.value.retryable
+        assert dc_eng.imports_failed == 1
+        assert cache_snapshot(dc_eng.cache) == snap, \
+            "a rejected handoff must leak no pool state"
+
+    def test_max_new_one_degenerate_handoff(self, tiny):
+        """max_new_tokens == 1: the prefill side already produced the
+        only token; the decode side admits, completes immediately, and
+        leaks nothing."""
+        rng = np.random.RandomState(4)
+        prompts = [list(rng.randint(0, 256, 9))]
+        ref = run_requests(make_engine(tiny), prompts, max_new=1)
+        got, _, dc_eng = disagg_requests(tiny, prompts, max_new=1)
+        assert_bitwise_equal(got, ref)
+        assert dc_eng.cache.free_blocks == dc_eng.cache.n_blocks
+        assert dc_eng.forwards == 0, \
+            "a one-token handoff must cost the decode side zero launches"
+
+
+# ---------------------------------------------------------------------------
+# Failure semantics: bounded retry, fallback, the failover split
+# ---------------------------------------------------------------------------
+
+class TestHandoffFailure:
+    def test_pressure_rejects_state_unchanged_then_heals(self, tiny):
+        from tony_tpu.serve import EngineFront, HandoffError, KVShipper
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode", n_blocks=4)
+        dc = DecodeFront(EngineFront(dc_eng))
+        dc_eng.cache.reserve("hog", 16)     # 2 of 4 blocks
+        rng = np.random.RandomState(5)
+        p = list(rng.randint(0, 256, 12))   # 3-block total extent
+        snap = cache_snapshot(dc_eng.cache)
+        pf = PrefillFront(EngineFront(pf_eng),
+                          shipper=KVShipper(max_attempts=3, backoff_s=0.0))
+        with pytest.raises(HandoffError) as ei:
+            pf.prefill_handoff(p, 5, rid="r", decode=dc)
+        assert not ei.value.retryable
+        assert dc_eng.imports_failed == 3, "every bounded attempt counted"
+        assert cache_snapshot(dc_eng.cache) == snap, "state unchanged"
+        # The prefill gang is NOT wedged: its pool is clean and the next
+        # prompt prefills immediately.
+        assert pf_eng.cache.free_blocks == pf_eng.cache.n_blocks
+        dc_eng.cache.free_seq("hog")
+        out = pf.prefill_handoff(p, 5, rid="r2", decode=dc)
+        ref = run_requests(make_engine(tiny), [p], max_new=5)
+        assert out.tokens == ref[0].tokens
+
+    def test_prefill_pool_pressure_falls_back_colocated(self, tiny):
+        """Transient PREFILL-pool pressure: prefill_only has no queue
+        to park the request in (a colocated engine absorbs the same
+        pressure by leaving it queued), so the shipper side re-types
+        the retryable AdmissionError as a non-retryable HandoffError
+        and the router's dispatch falls back to COLOCATED prefill on
+        the decode replica — identical tokens, no hard failure.
+        Never-fits still propagates as the request-level rejection."""
+        from tony_tpu.serve import (AdmissionError, EngineFront,
+                                    HandoffError, RequestRouter)
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill", n_blocks=4)
+        dc_eng = make_engine(tiny, role="decode")
+        pf_eng.cache.reserve("hog", 24)     # 3 of 4 blocks
+        pf = PrefillFront(EngineFront(pf_eng))
+        dc = DecodeFront(EngineFront(dc_eng))
+        rng = np.random.RandomState(7)
+        p = list(rng.randint(0, 256, 12))   # needs 2 blocks, 1 free
+        with pytest.raises(HandoffError) as ei:
+            pf.prefill_handoff(p, 5, rid="r", decode=dc)
+        assert not ei.value.retryable
+        router = RequestRouter(block_size=8)
+        router.upsert_replica("prefill:0", client=pf,
+                              stats=pf_eng.stats())
+        router.upsert_replica("decode:0", client=dc,
+                              stats=dc_eng.stats())
+        out = router.dispatch(p, 5, rid="r2")
+        assert out["replica"] == "decode:0"
+        assert router.stats()["handoff_fallbacks"] == 1
+        assert router.stats()["failovers"] == 0, \
+            "pool pressure must not down-mark the prefill replica"
+        ref = run_requests(make_engine(tiny), [p], max_new=5)
+        assert out["tokens"] == ref[0].tokens
+        # Over the whole pool outright: the non-retryable
+        # AdmissionError propagates, exactly like colocated submit.
+        big = list(rng.randint(0, 256, 40))  # 5 blocks > 4-block pool
+        with pytest.raises(AdmissionError) as ei2:
+            pf.prefill_handoff(big, 5, rid="r3", decode=dc)
+        assert not ei2.value.retryable
+
+    def test_missing_payload_field_rejects_typed(self, tiny):
+        """A version-skewed payload missing (or mistyping) a required
+        field is the same typed, counted, state-unchanged rejection as
+        every other malformed field — never a bare KeyError escaping
+        the (AdmissionError, HandoffError) failover split."""
+        from tony_tpu.serve import HandoffError
+
+        dc_eng = make_engine(tiny, role="decode")
+        snap = cache_snapshot(dc_eng.cache)
+        base = {"rid": "r", "tokens": [1, 2, 3], "max_new_tokens": 4,
+                "first_token": 5, "length": 3, "keys": [], "blocks": [],
+                **dc_eng.cache.wire_header()}
+        bad = []
+        for missing in ("rid", "tokens", "max_new_tokens", "first_token"):
+            payload = dict(base)
+            del payload[missing]
+            bad.append(payload)
+        bad.append(dict(base, tokens=None))          # mistyped
+        for payload in bad:
+            with pytest.raises(HandoffError) as ei:
+                dc_eng.admit_handoff(payload)
+            assert not ei.value.retryable
+        assert dc_eng.imports_failed == len(bad), "every rejection counted"
+        assert cache_snapshot(dc_eng.cache) == snap, "state unchanged"
+
+    def test_truncated_blocks_reject_typed(self, tiny):
+        """A payload whose blocks field is truncated or absent passes
+        every per-block check (CRC only guards blocks that ARE
+        present) — the admission must still reject typed rather than
+        decode the uncovered prompt extent from uninitialized pool
+        blocks, silently wrong."""
+        from tony_tpu.serve import EngineFront, HandoffError
+        from tony_tpu.serve.engine import Request
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode")
+        rng = np.random.RandomState(9)
+        p = list(rng.randint(0, 256, 12))
+        front = EngineFront(pf_eng)
+        with front._drive:
+            payload = pf_eng.prefill_only(
+                Request(rid="r", tokens=p, max_new_tokens=4))
+        snap = cache_snapshot(dc_eng.cache)
+        for bad in (dict(payload, blocks=payload["blocks"][:-1]),
+                    {k: v for k, v in payload.items() if k != "blocks"}):
+            with pytest.raises(HandoffError) as ei:
+                dc_eng.admit_handoff(bad)
+            assert not ei.value.retryable
+        assert cache_snapshot(dc_eng.cache) == snap, "state unchanged"
+
+    def test_rid_collision_rejects_typed_and_minted_rids_unique(
+            self, tiny):
+        """Minted rids carry a per-front namespace (a prefill front's
+        rid lands on a decode engine that also mints its own), and a
+        caller-supplied duplicate rejects typed BEFORE the import —
+        not as the cache's bare fresh-admission ValueError escaping
+        the failover split."""
+        from tony_tpu.serve import EngineFront, HandoffError
+        from tony_tpu.serve.engine import Request
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode")
+        f1, f2 = EngineFront(pf_eng), EngineFront(dc_eng)
+        rids = {f1.fresh_rid() for _ in range(4)} \
+            | {f2.fresh_rid() for _ in range(4)}
+        assert len(rids) == 8, "two fronts must not share a namespace"
+        rng = np.random.RandomState(10)
+        p = list(rng.randint(0, 256, 12))
+        with f1._drive:
+            payload = pf_eng.prefill_only(
+                Request(rid="dup", tokens=p, max_new_tokens=4))
+        dc_eng.cache.reserve("dup", 8)     # a live holder of the rid
+        snap = cache_snapshot(dc_eng.cache)
+        with pytest.raises(HandoffError) as ei:
+            dc_eng.admit_handoff(payload)
+        assert not ei.value.retryable
+        assert cache_snapshot(dc_eng.cache) == snap
+
+    def test_router_falls_back_to_colocated_on_decode(self, tiny):
+        """A decode pool under pressure: every bounded shipping attempt
+        is rejected, and the router's dispatch falls back to COLOCATED
+        prefill on the decode replica — identical tokens, one fallback
+        counted, fleet not down-marked."""
+        from tony_tpu.serve import (AdmissionError, EngineFront,
+                                    KVShipper, RequestRouter)
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode")
+
+        class PressuredDecode(DecodeFront):
+            """Rejects every import retryably (the wire form of a pool
+            under sustained pressure — the deterministic stand-in for
+            the engine-level rejection test_pressure_rejects pins)."""
+
+            imports = 0
+
+            def kv_import(self, payload):
+                PressuredDecode.imports += 1
+                raise AdmissionError("decode pool exhausted",
+                                     needed_blocks=3, free_blocks=0)
+
+        router = RequestRouter(block_size=8)
+        router.upsert_replica(
+            "prefill:0",
+            client=PrefillFront(EngineFront(pf_eng),
+                                shipper=KVShipper(max_attempts=2,
+                                                  backoff_s=0.0)),
+            stats=pf_eng.stats())
+        router.upsert_replica(
+            "decode:0", client=PressuredDecode(EngineFront(dc_eng)),
+            stats=dc_eng.stats())
+        rng = np.random.RandomState(6)
+        p = list(rng.randint(0, 256, 12))
+        out = router.dispatch(p, 5, rid="r")
+        assert PressuredDecode.imports == 2, "bounded shipping budget"
+        assert out["replica"] == "decode:0"
+        assert router.stats()["handoff_fallbacks"] == 1
+        assert router.stats()["failovers"] == 0, \
+            "a request-level rejection must not down-mark the fleet"
+        ref = run_requests(make_engine(tiny), [p], max_new=5)
+        assert out["tokens"] == ref[0].tokens
+
+    def test_prefill_transport_fault_fails_over(self, tiny):
+        """The PR 13 failover split, kept: a DEAD prefill replica
+        (OSError) is down-marked and the request re-dispatches to the
+        live prefill replica; request-level errors still propagate."""
+        from tony_tpu.serve import (AdmissionError, EngineFront,
+                                    RequestRouter)
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        class DeadPrefill:
+            def prefill_handoff(self, tokens, max_new_tokens, rid=None,
+                                decode=None):
+                raise ConnectionRefusedError("replica gone")
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode")
+        router = RequestRouter(block_size=8)
+        router.upsert_replica("prefill:0", client=DeadPrefill(),
+                              stats={"role": "prefill",
+                                     "queue_depth": 0.0})
+        # The live prefill replica scores WORSE (deeper queue), so the
+        # dead one wins the first route and the dispatch must fail over.
+        router.upsert_replica("prefill:1",
+                              client=PrefillFront(EngineFront(pf_eng)),
+                              stats={**pf_eng.stats(),
+                                     "queue_depth": 2.0})
+        router.upsert_replica("decode:0",
+                              client=DecodeFront(EngineFront(dc_eng)),
+                              stats=dc_eng.stats())
+        rng = np.random.RandomState(7)
+        p = list(rng.randint(0, 256, 9))
+        out = router.dispatch(p, 4, rid="r", session_id="s")
+        assert out["prefill_replica"] == "prefill:1"
+        assert router.stats()["failovers"] >= 1
+        assert not [v for v in router.replicas()
+                    if v.name == "prefill:0"][0].alive
+        # Request-level error: an oversized prompt propagates untouched
+        # (never fits the decode extent), fleet stays up.
+        with pytest.raises(AdmissionError):
+            router.dispatch(list(rng.randint(0, 256, 30)), 60, rid="r2")
+        assert [v for v in router.replicas()
+                if v.name == "prefill:1"][0].alive
+
+
+# ---------------------------------------------------------------------------
+# Role-aware routing decisions
+# ---------------------------------------------------------------------------
+
+class TestRouterRoles:
+    def _mk(self, **stats):
+        base = {"queue_depth": 0.0, "running": 0.0, "p99_ms": 0.0}
+        base.update(stats)
+        return base
+
+    def test_route_split_scores_prefill_by_overlap_decode_by_queue(self):
+        from tony_tpu.serve import RequestRouter
+        from tony_tpu.serve import prefix
+
+        router = RequestRouter(block_size=4)
+        toks = list(range(12))
+        keys = prefix.chain_keys(toks, 4)
+        router.upsert_replica("prefill:0", address="h:1", stats=self._mk(
+            role="prefill", prefix_digest=keys[:2]))
+        router.upsert_replica("prefill:1", address="h:2", stats=self._mk(
+            role="prefill"))
+        router.upsert_replica("decode:0", address="h:3", stats=self._mk(
+            role="decode", queue_depth=3.0))
+        router.upsert_replica("decode:1", address="h:4", stats=self._mk(
+            role="decode", queue_depth=1.0))
+        pf, dc = router.route_split(toks)
+        assert (pf, dc) == ("prefill:0", "decode:1")
+
+    def test_sticky_pair_affinity_and_repin(self):
+        from tony_tpu.serve import RequestRouter
+
+        router = RequestRouter(block_size=4)
+        for n, r in (("prefill:0", "prefill"), ("prefill:1", "prefill"),
+                     ("decode:0", "decode"), ("decode:1", "decode")):
+            router.upsert_replica(n, address=f"h:{n}",
+                                  stats=self._mk(role=r))
+        pf1, dc1 = router.route_split([1, 2, 3], session_id="s")
+        # Load changes do not move a pinned session...
+        router.upsert_replica(dc1, address=f"h:{dc1}", stats=self._mk(
+            role="decode", queue_depth=9.0))
+        assert router.route_split([1, 2, 3], session_id="s") == (pf1, dc1)
+        assert router.affinity_hits == 1
+        # ...until a half retires: the pair re-routes and re-pins.
+        router.retire_replica(dc1)
+        pf2, dc2 = router.route_split([1, 2, 3], session_id="s")
+        assert dc2 != dc1
+        assert router.route_split([1, 2, 3], session_id="s") == (pf2, dc2)
+
+    def test_colocated_fleet_has_no_split(self, tiny):
+        from tony_tpu.serve import EngineFront, RequestRouter
+
+        eng = make_engine(tiny)
+        router = RequestRouter(block_size=8)
+        router.upsert_replica("serve:0", client=EngineFront(eng),
+                              stats=eng.stats())
+        assert router.route_split([1, 2, 3]) == (None, None)
+        rng = np.random.RandomState(8)
+        p = list(rng.randint(0, 256, 9))
+        out = router.dispatch(p, 4, rid="r")
+        assert out["replica"] == "serve:0"
+        assert "prefill_replica" not in out
+        assert router.stats()["handoffs"] == 0
+
+    def test_split_dissolving_mid_retry_serves_colocated(self, tiny):
+        """The whole prefill gang dies mid-dispatch: the failover
+        down-marks it, the split dissolves, and the SAME request still
+        completes on the surviving decode replica's colocated path —
+        a lost gang costs a retry, never the request."""
+        from tony_tpu.serve import EngineFront, RequestRouter
+        from tony_tpu.serve.disagg import DecodeFront
+
+        class DeadPrefill:
+            def prefill_handoff(self, tokens, max_new_tokens, rid=None,
+                                decode=None):
+                raise ConnectionRefusedError("gang gone")
+
+        dc_eng = make_engine(tiny, role="decode")
+        router = RequestRouter(block_size=8)
+        router.upsert_replica("prefill:0", client=DeadPrefill(),
+                              stats={"role": "prefill",
+                                     "queue_depth": 0.0})
+        router.upsert_replica("decode:0",
+                              client=DecodeFront(EngineFront(dc_eng)),
+                              stats=dc_eng.stats())
+        rng = np.random.RandomState(13)
+        p = list(rng.randint(0, 256, 9))
+        out = router.dispatch(p, 4, rid="r")
+        assert out["replica"] == "decode:0"
+        assert "prefill_replica" not in out, "served colocated"
+        assert router.stats()["failovers"] == 1
+        ref = run_requests(make_engine(tiny), [p], max_new=4)
+        assert out["tokens"] == ref[0].tokens
+
+    def test_split_dissolved_falls_back_to_colocated_path(self, tiny):
+        """Only a prefill gang is live (decode gang lost): dispatch runs
+        the plain colocated path on whatever serves — no wedge."""
+        from tony_tpu.serve import EngineFront, RequestRouter
+        from tony_tpu.serve.disagg import PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill")
+        front = EngineFront(pf_eng)
+        router = RequestRouter(block_size=8)
+        router.upsert_replica("prefill:0", client=PrefillFront(front),
+                              stats=pf_eng.stats())
+        rng = np.random.RandomState(9)
+        p = list(rng.randint(0, 256, 9))
+        out = router.dispatch(p, 4, rid="r")
+        assert out["replica"] == "prefill:0"
+
+
+# ---------------------------------------------------------------------------
+# The widened heartbeat schema: stats file -> heartbeat -> session ->
+# router ingestion, and the scaling matrix pinned under the new fields.
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatSchema:
+    NEW_FIELDS = ("blocks_shipped", "handoff_ms", "imports_failed")
+
+    def test_stats_fields_present_and_zero_on_colocated(self, tiny):
+        eng = make_engine(tiny)
+        s = eng.stats()
+        assert s["role"] == "colocated"
+        for f in self.NEW_FIELDS:
+            assert s[f] == 0.0, f
+
+    def test_prefill_role_reports_load(self, tiny):
+        """A prefill replica's heartbeat must show its handoff load —
+        handoffs never queue or join the running batch, so without the
+        prefill_only completion event the gang would report
+        qps=0/p99=0 forever and the per-gang autoscaler (and the
+        router's load scoring) could never see a prefill burst."""
+        from tony_tpu.serve import EngineFront
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode")
+        pf = PrefillFront(EngineFront(pf_eng))
+        dc = DecodeFront(EngineFront(dc_eng))
+        rng = np.random.RandomState(11)
+        for i in range(2):
+            pf.prefill_handoff(list(rng.randint(0, 256, 12)), 3,
+                               rid=f"r{i}", decode=dc)
+        s = pf_eng.stats()
+        assert s["completed"] == 2.0
+        assert s["qps"] > 0.0 and s["p99_ms"] > 0.0
+
+    def test_round_trip_stats_file_to_router(self, tiny, tmp_path):
+        """The full ingestion chain a fleet runs: engine stats file ->
+        executor reader -> heartbeat RPC -> session -> serve_endpoints
+        -> router view, with the role STRING and handoff counters
+        surviving every hop."""
+        from tony_tpu.conf import TonyConfig, serve_role_key
+        from tony_tpu.executor import read_serve_stats
+        from tony_tpu.rpc import ApplicationRpcHandler
+        from tony_tpu.serve import RequestRouter
+        from tony_tpu.session import TonySession
+
+        eng = make_engine(tiny, role="prefill", prefix_cache=True)
+        eng.blocks_shipped = 7
+        eng.handoff_ms = 12.5
+        path = tmp_path / "stats.json"
+        eng.write_stats(str(path), extra={"rpc_port": 4242})
+        stats = read_serve_stats(path)
+        assert stats["role"] == "prefill"
+        assert stats["blocks_shipped"] == 7.0
+        assert stats["handoff_ms"] == 12.5
+
+        conf = TonyConfig({"tony.prefill.instances": "1",
+                           "tony.prefill.command": "x",
+                           "tony.decode.instances": "1",
+                           "tony.decode.command": "x",
+                           serve_role_key("prefill"): "prefill",
+                           serve_role_key("decode"): "decode"})
+        session = TonySession(conf, "app_disagg")
+        handler = ApplicationRpcHandler(session)
+        session.on_registered("prefill", 0, "hostA", 1)
+        session.on_registered("decode", 0, "hostB", 2)
+        handler.rpc_heartbeat("prefill", 0, serve=stats)
+        dec = make_engine(tiny, role="decode")
+        handler.rpc_heartbeat("decode", 0, serve={
+            **dec.stats(), "rpc_port": 4243})
+        assert set(session.serve_job_types()) == {"prefill", "decode"}
+        eps = handler.rpc_serve_endpoints()
+        assert {e["job_type"] for e in eps} == {"prefill", "decode"}
+        router = RequestRouter(block_size=8)
+        router.refresh_from_task_infos(eps)
+        views = {v.name: v for v in router.replicas()}
+        assert views["prefill:0"].role == "prefill"
+        assert views["prefill:0"].address == "hostA:4242"
+        assert views["decode:0"].role == "decode"
+
+    def test_scaling_decision_matrix_pinned_under_new_fields(self):
+        """ScalingPolicy.decide is UNCHANGED by role/handoff fields:
+        the same matrix the PR 12/13 tests pin, with the new keys
+        riding along."""
+        from tony_tpu.serve.scaling import ScalingPolicy, decide
+
+        policy = ScalingPolicy(min_replicas=1, max_replicas=3,
+                               queue_high=8.0, queue_low=1.0,
+                               cooldown_s=30.0)
+        extra = {"role": "decode", "blocks_shipped": 100.0,
+                 "handoff_ms": 5.0, "imports_failed": 2.0}
+        mk = lambda qd: {"queue_depth": qd, "p99_ms": 0.0, **extra}
+        assert decide(policy, 0, [], now=0.0) == 1          # floor repair
+        assert decide(policy, 1, [mk(20.0)], now=100.0) == 1    # hot
+        assert decide(policy, 2, [mk(0.0), mk(0.0)], now=100.0) == -1
+        assert decide(policy, 2, [mk(4.0), mk(4.0)], now=100.0) == 0
+        assert decide(policy, 2, [mk(20.0)], now=10.0,
+                      last_action=0.0) == 0                 # cooldown
+
+    def test_cli_role_builds_heterogeneous_jobtypes(self):
+        from tony_tpu import conf as conf_mod
+        from tony_tpu.cli import make_parser
+
+        args = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--ckpt_dir", "/tmp/ck",
+            "--role", "prefill=2,decode=3", "--prefill_chunk", "32"])
+        # Build the conf exactly as cmd_serve does, without submitting.
+        captured = {}
+
+        class FakeClient:
+            def __init__(self, cfg, **kw):
+                captured["cfg"] = cfg
+
+            def run(self, timeout=None):
+                return 0
+
+        import tony_tpu.client as client_mod
+        real = client_mod.TonyClient
+        client_mod.TonyClient = FakeClient
+        try:
+            assert args.fn(args) == 0
+        finally:
+            client_mod.TonyClient = real
+        cfg = captured["cfg"]
+        assert cfg.get_int(conf_mod.instances_key("prefill"), 0) == 2
+        assert cfg.get_int(conf_mod.instances_key("decode"), 0) == 3
+        assert cfg.get(conf_mod.serve_role_key("prefill")) == "prefill"
+        assert cfg.get(conf_mod.serve_role_key("decode")) == "decode"
+        assert cfg.get(conf_mod.instances_key("serve")) is None
+        for jt in ("prefill", "decode"):
+            assert cfg.get(conf_mod.command_key(jt)) \
+                == "python -m tony_tpu.serve.replica"
+
+    def test_cli_role_validation(self):
+        from tony_tpu.cli import make_parser
+
+        for bad in ("warble=2", "prefill=0,decode=1", "prefill=2",
+                    "prefill=x,decode=1"):
+            args = make_parser().parse_args([
+                "serve", "--model", "m", "--ckpt_dir", "/tmp/ck",
+                "--role", bad])
+            with pytest.raises(SystemExit):
+                args.fn(args)
+
+
+class TestFleetCeiling:
+    """One ``--max_replicas`` is a FLEET ceiling on a split fleet: the
+    per-gang policy maxes can never sum past it — two gangs must not
+    each inflate to the whole budget."""
+
+    def test_apportion_fleet_max(self):
+        from tony_tpu.serve.scaling import apportion_fleet_max
+
+        assert apportion_fleet_max({"prefill": 2, "decode": 4}, 12) == \
+            {"prefill": 4, "decode": 8}
+        # No headroom (or a ceiling below the floors): floors stand.
+        assert apportion_fleet_max({"prefill": 2, "decode": 4}, 6) == \
+            {"prefill": 2, "decode": 4}
+        assert apportion_fleet_max({"prefill": 2, "decode": 4}, 0) == \
+            {"prefill": 2, "decode": 4}
+        # Largest-remainder headroom: shares sum exactly to the ceiling.
+        assert apportion_fleet_max({"prefill": 2, "decode": 4}, 9) == \
+            {"prefill": 3, "decode": 6}
+        got = apportion_fleet_max({"a": 1, "b": 2}, 5)
+        assert sum(got.values()) == 5 and got["a"] >= 1 and got["b"] >= 2
+
+    def test_split_fleet_policies_respect_one_ceiling(self):
+        from tony_tpu.conf import (SERVE_REPLICAS_MAX, TonyConfig,
+                                   serve_replicas_max_key)
+        from tony_tpu.serve.scaling import ScalingPolicy
+
+        cfg = TonyConfig()
+        cfg.set(SERVE_REPLICAS_MAX, "12")
+        floors = {"prefill": 2, "decode": 4}
+        pols = {jt: ScalingPolicy.from_conf(cfg, floors[jt], job_type=jt,
+                                            fleet_floors=floors)
+                for jt in floors}
+        assert pols["prefill"].max_replicas == 4
+        assert pols["decode"].max_replicas == 8
+        assert sum(p.max_replicas for p in pols.values()) == 12
+        # Per-gang override wins over the apportioned share.
+        cfg.set(serve_replicas_max_key("decode"), "10")
+        pol = ScalingPolicy.from_conf(cfg, 4, job_type="decode",
+                                      fleet_floors=floors)
+        assert pol.max_replicas == 10
+        # A colocated fleet (one serve jobtype) keeps the classic
+        # whole-budget semantics.
+        pol = ScalingPolicy.from_conf(cfg, 2, job_type="serve",
+                                      fleet_floors={"serve": 2})
+        assert pol.max_replicas == 12
+
+
+# ---------------------------------------------------------------------------
+# The RPC wire end to end (slow: real servers, three replicas)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestDisaggOverRpc:
+    def test_fleet_e2e_over_rpc_with_handoff(self, tiny):
+        """The full wire: router (RPC dial) -> prefill replica RPC ->
+        replica-to-replica KV ship (kv_offer/kv_import verbs) -> decode
+        replica drives to completion. Token identity vs the colocated
+        engine; handoff counters visible in serve_stats."""
+        from tony_tpu.rpc import RpcServer
+        from tony_tpu.serve import EngineFront, RequestRouter
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+        from tony_tpu.serve.replica import _ReplicaRpcHandler
+
+        class MiniReplica:
+            """The request-path surface of serve.replica.Replica,
+            without the ckpt restore (the e2e restore path is pinned by
+            tests/test_serve.py)."""
+
+            def __init__(self, eng):
+                self.engine = eng
+                self._front = EngineFront(eng)
+                self._prefill_front = PrefillFront(self._front)
+                self._decode_front = DecodeFront(self._front)
+
+            def generate(self, tokens, max_new_tokens, rid=None):
+                return self._front.generate(tokens, max_new_tokens,
+                                            rid=rid)
+
+            def prefill_handoff(self, tokens, max_new_tokens, rid=None,
+                                decode=None):
+                return self._prefill_front.prefill_handoff(
+                    tokens, max_new_tokens, rid=rid, decode=decode)
+
+            def kv_offer(self, keys):
+                return self._decode_front.kv_offer(keys)
+
+            def kv_import(self, payload):
+                return self._decode_front.kv_import(payload)
+
+        pf_eng = make_engine(tiny, role="prefill", prefill_chunk=16,
+                             keep_logits=False)
+        dc_eng = make_engine(tiny, role="decode", keep_logits=False)
+        servers = []
+        try:
+            addrs = {}
+            for name, eng in (("prefill:0", pf_eng), ("decode:0", dc_eng)):
+                srv = RpcServer(
+                    _ReplicaRpcHandler(MiniReplica(eng)),
+                    host="127.0.0.1", port=0)
+                srv.start()
+                servers.append(srv)
+                addrs[name] = srv.address
+            router = RequestRouter(block_size=8, dial_timeout_s=5.0)
+            router.upsert_replica("prefill:0", address=addrs["prefill:0"],
+                                  stats={**pf_eng.stats()})
+            router.upsert_replica("decode:0", address=addrs["decode:0"],
+                                  stats={**dc_eng.stats()})
+            rng = np.random.RandomState(10)
+            prompts = [list(rng.randint(0, 256, n)) for n in (9, 17)]
+            outs = [router.dispatch(p, 5, rid=f"r{i}")
+                    for i, p in enumerate(prompts)]
+            ref_eng = make_engine(tiny, keep_logits=False)
+            ref = run_requests(ref_eng, prompts, max_new=5)
+            for i, out in enumerate(outs):
+                assert out["tokens"] == ref[i].tokens
+                assert out["replica"] == "decode:0"
+                assert out["prefill_replica"] == "prefill:0"
+            assert pf_eng.blocks_shipped > 0
+            assert pf_eng.handoff_ms > 0
+            assert dc_eng.handoffs_in == 2
+            assert pf_eng.cache.free_blocks == pf_eng.cache.n_blocks
+            assert dc_eng.cache.free_blocks == dc_eng.cache.n_blocks
+        finally:
+            for srv in servers:
+                srv.stop()
+
+    def test_long_prompt_handoff_bitwise(self, tiny):
+        """A prompt near the context extent crosses many blocks through
+        chunked prefill and a multi-block ship — the handoff byte math
+        at its worst case, still bit-for-bit."""
+        rng = np.random.RandomState(11)
+        prompts = [list(rng.randint(0, 256, 57))]   # 8 blocks of 8
+        ref = run_requests(make_engine(tiny), prompts, max_new=4)
+        got, pf_eng, dc_eng = disagg_requests(
+            tiny, prompts, max_new=4, prefill_kw={"prefill_chunk": 16})
+        assert_bitwise_equal(got, ref)
+        assert pf_eng.blocks_shipped == 8
+        assert dc_eng.cache.imported_total == 8

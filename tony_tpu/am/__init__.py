@@ -291,7 +291,7 @@ class ApplicationMaster:
                     f"executor exited with {c.exit_code} without reporting")
 
     def _autoscale_serve(self, session: TonySession) -> None:
-        """Heartbeat-driven replica scaling for the ``serve`` job type
+        """Heartbeat-driven replica scaling for every serving jobtype
         (tony_tpu.serve): feed the replicas' piggybacked qps/p99/queue-
         depth into the pure :func:`tony_tpu.serve.scaling.decide` policy
         and apply the delta — launch an ELASTIC task on scale-up, retire
@@ -299,50 +299,70 @@ class ApplicationMaster:
         floor is untouchable). Autoscale is off unless the conf raises
         ``tony.serve.replicas.max`` above the static instance count.
         Only runs after the gang barrier: the initial gang must seal its
-        cluster spec before membership gets elastic."""
-        jt = constants.SERVE
-        if jt not in self.conf.job_types():
-            return
+        cluster spec before membership gets elastic.
+
+        Per-JOBTYPE since the disaggregated split (the first
+        heterogeneous-gang consumer): a job's prefill and decode gangs
+        are separate serve-role jobtypes, each with its own policy
+        instance (floor = its own conf instance count), cooldown clock,
+        and samples — a prefill burst scales the prefill gang, the
+        decode floor stays put."""
         if self.handler is None or not self.handler._all_registered_fired:
+            return
+        serve_jts = session.serve_job_types()
+        if not serve_jts:
             return
         from tony_tpu.serve import scaling    # jax-free
 
         if not hasattr(self, "_serve_policy"):
-            self._serve_policy = scaling.ScalingPolicy.from_conf(
-                self.conf, self.conf.instances(jt))
-            self._serve_scale_last: Optional[float] = None
-        policy = self._serve_policy
-        live = [t for t in session.tasks()
-                if t.job_type == jt and not t.status.is_terminal]
-        # Floor REPAIR runs even when autoscale is off: `tony serve`
-        # disables fail-fast on the promise that a crashed replica gets
-        # replaced, so below-floor recovery must not hide behind the
-        # max>min autoscale arming.
-        if not policy.enabled and len(live) >= policy.min_replicas:
-            return
-        now = time.monotonic()
-        delta = scaling.decide(policy, len(live), session.serve_samples(jt),
-                               now=now, last_action=self._serve_scale_last)
-        if delta > 0:
-            for _ in range(delta):
-                task = session.add_task(jt)
-                self._log(f"serve scale-up -> launching elastic replica "
-                          f"{task.task_id} ({len(live) + 1} live)")
-                self._try_launch(session, jt, task.index)
-            self._serve_scale_last = now
-        elif delta < 0:
-            victims = sorted((t for t in live if t.elastic),
-                             key=lambda t: t.index, reverse=True)
-            if victims:
-                victim = victims[0]
-                self._log(f"serve scale-down -> retiring elastic replica "
-                          f"{victim.task_id} ({len(live) - 1} live)")
-                session.mark_scaled_down(
-                    victim, "replica scale-down (load below floor)")
-                c = self._containers.get(victim.task_id)
-                if c is not None and c.is_running:
-                    self.scheduler.stop_container(c)
-                self._serve_scale_last = now
+            self._serve_policy: Dict[str, object] = {}
+            self._serve_scale_last: Dict[str, Optional[float]] = {}
+        for jt in serve_jts:
+            if jt not in self._serve_policy:
+                # job_type + fleet_floors: on a split fleet the global
+                # replicas.max is a FLEET ceiling apportioned across
+                # the gangs (scaling.apportion_fleet_max), overridable
+                # per gang via tony.serve.replicas.max.<jobtype>.
+                self._serve_policy[jt] = scaling.ScalingPolicy.from_conf(
+                    self.conf, self.conf.instances(jt), job_type=jt,
+                    fleet_floors={j: self.conf.instances(j)
+                                  for j in serve_jts})
+                self._serve_scale_last[jt] = None
+            policy = self._serve_policy[jt]
+            live = [t for t in session.tasks()
+                    if t.job_type == jt and not t.status.is_terminal]
+            # Floor REPAIR runs even when autoscale is off: `tony serve`
+            # disables fail-fast on the promise that a crashed replica
+            # gets replaced, so below-floor recovery must not hide
+            # behind the max>min autoscale arming.
+            if not policy.enabled and len(live) >= policy.min_replicas:
+                continue
+            now = time.monotonic()
+            delta = scaling.decide(policy, len(live),
+                                   session.serve_samples(jt), now=now,
+                                   last_action=self._serve_scale_last[jt])
+            if delta > 0:
+                for _ in range(delta):
+                    task = session.add_task(jt)
+                    self._log(f"serve scale-up -> launching elastic "
+                              f"replica {task.task_id} "
+                              f"({len(live) + 1} live)")
+                    self._try_launch(session, jt, task.index)
+                self._serve_scale_last[jt] = now
+            elif delta < 0:
+                victims = sorted((t for t in live if t.elastic),
+                                 key=lambda t: t.index, reverse=True)
+                if victims:
+                    victim = victims[0]
+                    self._log(f"serve scale-down -> retiring elastic "
+                              f"replica {victim.task_id} "
+                              f"({len(live) - 1} live)")
+                    session.mark_scaled_down(
+                        victim, "replica scale-down (load below floor)")
+                    c = self._containers.get(victim.task_id)
+                    if c is not None and c.is_running:
+                        self.scheduler.stop_container(c)
+                    self._serve_scale_last[jt] = now
 
     def _collect_traces_later(self, session: TonySession,
                               delay_s: float) -> None:

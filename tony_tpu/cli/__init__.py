@@ -87,9 +87,45 @@ def cmd_serve(args: argparse.Namespace) -> int:
     cfg.set(conf_mod.APPLICATION_NAME,
             args.name or f"tony-serve-{args.model}")
     cfg.set(conf_mod.APPLICATION_STOP_ON_FAILURE, "false")
-    cfg.set(conf_mod.instances_key("serve"), str(args.replicas))
-    cfg.set(conf_mod.command_key("serve"),
-            "python -m tony_tpu.serve.replica")
+    # Disaggregated split (--role prefill=2,decode=4): each role becomes
+    # its OWN jobtype — the heterogeneous-gang wiring — sharing the
+    # serve.* engine config; the per-jobtype role key tells each replica
+    # which half of the handoff protocol it fronts. Validate the spec at
+    # SUBMIT: a typo'd role that silently became a colocated gang would
+    # serve the wrong topology without a word.
+    if args.role:
+        roles = {}
+        for part in args.role.split(","):
+            name, _, count = part.partition("=")
+            name = name.strip()
+            if name not in ("prefill", "decode", "colocated"):
+                raise SystemExit(f"--role: unknown role {name!r} "
+                                 f"(prefill|decode|colocated)")
+            if name in roles:
+                raise SystemExit(f"--role: duplicate role {name!r}")
+            try:
+                n = int(count)
+            except ValueError:
+                raise SystemExit(f"--role: need {name}=<count>, got "
+                                 f"{part!r}") from None
+            if n < 1:
+                raise SystemExit(f"--role: {name} needs >= 1 replica, "
+                                 f"got {n}")
+            roles[name] = n
+        if ("prefill" in roles) != ("decode" in roles):
+            raise SystemExit("--role: a split fleet needs BOTH a "
+                             "prefill and a decode gang (the router "
+                             "falls back to colocated only per-request, "
+                             "not per-topology)")
+        for name, n in roles.items():
+            cfg.set(conf_mod.instances_key(name), str(n))
+            cfg.set(conf_mod.command_key(name),
+                    "python -m tony_tpu.serve.replica")
+            cfg.set(conf_mod.serve_role_key(name), name)
+    else:
+        cfg.set(conf_mod.instances_key("serve"), str(args.replicas))
+        cfg.set(conf_mod.command_key("serve"),
+                "python -m tony_tpu.serve.replica")
     cfg.set(conf_mod.SERVE_MODEL, args.model)
     if args.model_kwargs:
         json_mod.loads(args.model_kwargs)   # validate at submit, not launch
@@ -379,7 +415,11 @@ def make_parser() -> argparse.ArgumentParser:
                     help="initial replica count (the autoscale floor)")
     sv.add_argument("--max_replicas", type=int, default=None,
                     help="autoscale ceiling (> --replicas arms the "
-                         "AM's heartbeat-driven scaler)")
+                         "AM's heartbeat-driven scaler); with --role "
+                         "it is the FLEET ceiling, apportioned across "
+                         "the gangs proportional to their floors "
+                         "(per-gang override: "
+                         "tony.serve.replicas.max.<jobtype>)")
     sv.add_argument("--dtype_policy", default="bf16", choices=("bf16", "f32"),
                     help="restore-time cast: f32 master -> serving dtype")
     sv.add_argument("--ctx_max", type=int, default=2048,
@@ -394,6 +434,13 @@ def make_parser() -> argparse.ArgumentParser:
                     help="chunked prefill rows per iteration (a 16-row "
                          "block multiple; 0 = monolithic): long prompts "
                          "interleave with decode instead of stalling it")
+    sv.add_argument("--role", default=None, metavar="ROLE=N[,ROLE=N...]",
+                    help="disaggregated prefill/decode split: per-role "
+                         "gang sizes, e.g. 'prefill=2,decode=4' — each "
+                         "role becomes its OWN jobtype (heterogeneous "
+                         "gangs in one job) and the router ships KV "
+                         "blocks prefill->decode over the RPC wire; "
+                         "omit for the classic colocated fleet")
     sv.add_argument("--spec_k", type=int, default=0,
                     help="speculative decoding draft depth (0 = off; "
                          "k tokens drafted, verified in ONE target "

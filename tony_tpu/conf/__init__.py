@@ -139,6 +139,14 @@ SERVE_DRAFT_MODEL = "tony.serve.draft.model"    # registered draft model
 SERVE_DRAFT_MODEL_KWARGS = "tony.serve.draft.model-kwargs"  # JSON kwargs
 SERVE_DRAFT_CKPT_DIR = "tony.serve.draft.ckpt-dir"  # draft training ckpt
 SERVE_DRAFT_NGRAM_MAX = "tony.serve.draft.ngram-max"  # fallback n-gram n
+# Disaggregated prefill/decode (PR 15): serve-role jobtypes. A jobtype
+# carrying tony.serve.role.<jobtype> = prefill|decode|colocated is a
+# serving gang of that role — the first heterogeneous-gang wiring: ONE
+# job runs a prefill gang and a decode gang as separate jobtypes, each
+# with its own instance count and autoscale floor, sharing the serve.*
+# engine config. The AM's autoscaler and the serve_endpoints verb treat
+# every role-keyed jobtype (plus the classic "serve") as serving.
+SERVE_ROLE_PREFIX = "tony.serve.role."
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)
@@ -163,6 +171,19 @@ def tpus_key(job_type: str) -> str:
 
 def command_key(job_type: str) -> str:
     return f"tony.{job_type}.command"       # per-jobtype command override
+
+def serve_role_key(job_type: str) -> str:
+    """Per-jobtype serving role (tony_tpu.serve.disagg):
+    ``tony.serve.role.<jobtype>`` = prefill|decode|colocated."""
+    return f"{SERVE_ROLE_PREFIX}{job_type}"
+
+def serve_replicas_max_key(job_type: str) -> str:
+    """Per-GANG autoscale ceiling override for a split fleet:
+    ``tony.serve.replicas.max.<jobtype>``. Without it, the global
+    ``tony.serve.replicas.max`` is a FLEET ceiling that the AM
+    apportions across the serve jobtypes (scaling.apportion_fleet_max)
+    — two gangs must not each inflate to the whole budget."""
+    return f"{SERVE_REPLICAS_MAX}.{job_type}"
 
 def env_key(job_type: str) -> str:
     return f"tony.{job_type}.env"           # csv KEY=VALUE extra env

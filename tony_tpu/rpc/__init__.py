@@ -349,12 +349,14 @@ class ApplicationRpcHandler:
     def rpc_get_task_infos(self) -> list:
         return self.session.task_infos()
 
-    def rpc_serve_endpoints(self, job_type: str = "serve") -> list:
-        """The routable replica set (tony_tpu.serve.router): serve
+    def rpc_serve_endpoints(self, job_type: Optional[str] = None) -> list:
+        """The routable replica set (tony_tpu.serve.router): serving
         tasks with reported telemetry, in task_infos wire form — the
         router derives each live replica's dial address from
         ``host`` + the heartbeat-carried ``rpc_port`` and retires
-        terminal entries."""
+        terminal entries. Default spans EVERY serve-role jobtype (the
+        disaggregated prefill/decode gangs included); pass a jobtype to
+        scope."""
         return self.session.serve_endpoints(job_type)
 
     def rpc_get_task_callback_info(self) -> Dict[str, str]:

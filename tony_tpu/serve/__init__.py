@@ -38,7 +38,14 @@ the execution loop watches every user rebuild it badly):
   (block digests carried on the heartbeat), queue depth, and p99, with
   sticky session affinity for multi-turn traffic and failover
   re-dispatch on replica retirement — the fleet, not a replica, is the
-  unit of throughput.
+  unit of throughput;
+* :mod:`~tony_tpu.serve.disagg` — disaggregated prefill/decode
+  (jax-free): prefill and decode split onto separate replica roles
+  (heterogeneous gangs of one job) with KV-block handoff over the RPC
+  wire — per-block CRC, shared-prefix stems adopted instead of
+  re-transferred, bounded retry with a typed :class:`~tony_tpu.serve.
+  disagg.HandoffError`, and the decode replica's loop issuing zero
+  prefill launches while the prefill gang absorbs bursts.
 
 Numerics contract: continuous-batching decode is BIT-identical to a
 sequential full prefill of the same tokens — every op in the serve
@@ -50,11 +57,12 @@ logits. ``tests/test_serve.py`` pins this end to end.
 
 from typing import Any
 
-__all__ = ["AdmissionError", "Completion", "EngineFront", "ModelDraft",
-           "NgramDraft", "NoReplicaError", "PagedKVCache", "Request",
+__all__ = ["AdmissionError", "Completion", "DecodeFront", "EngineFront",
+           "HandoffError", "KVShipper", "ModelDraft", "NgramDraft",
+           "NoReplicaError", "PagedKVCache", "PrefillFront", "Request",
            "RequestRouter", "RouterPolicy", "RouterServer", "ServeEngine",
-           "SpecEngine", "engine", "kvcache", "prefix", "replica",
-           "router", "scaling", "spec"]
+           "SpecEngine", "disagg", "engine", "kvcache", "prefix",
+           "replica", "router", "scaling", "spec"]
 
 # LAZY facade (PEP 562, like tony_tpu.analysis): the engine pulls jax,
 # but the AM's autoscaler only needs the pure scaling policy and the
@@ -69,6 +77,9 @@ _LAZY = {
     "ModelDraft": "spec", "NgramDraft": "spec", "SpecEngine": "spec",
     "NoReplicaError": "router", "RequestRouter": "router",
     "RouterPolicy": "router", "RouterServer": "router",
+    "HandoffError": "disagg", "KVShipper": "disagg",
+    "PrefillFront": "disagg", "DecodeFront": "disagg",
+    "disagg": None,
     "engine": None, "kvcache": None, "prefix": None, "replica": None,
     "router": None, "scaling": None, "spec": None,
 }

@@ -62,6 +62,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -72,6 +73,7 @@ import numpy as np
 from tony_tpu._trace import trace_record
 from tony_tpu.compat import mesh_context
 from tony_tpu.serve import prefix as prefix_mod
+from tony_tpu.serve.disagg import HandoffError, decode_f32, encode_f32
 from tony_tpu.serve.kvcache import AdmissionError, PagedKVCache
 
 _record = functools.partial(trace_record, "serve")
@@ -97,6 +99,14 @@ class Completion:
     tokens: List[int]
     logits: Optional[List[np.ndarray]]
     latency_s: float
+
+    def wire(self) -> Dict[str, Any]:
+        """THE serving wire form (the replica RPC verbs all speak it;
+        the jax-free router duck-types the same shape in
+        ``router._wire_completion`` since it cannot import this
+        class)."""
+        return {"rid": self.rid, "tokens": list(self.tokens),
+                "latency_ms": round(1e3 * self.latency_s, 3)}
 
 
 class _Seq:
@@ -256,10 +266,14 @@ class ServeEngine(PagedModelRunner):
                  keep_logits: bool = False, join_policy: str = "continuous",
                  stats_window_s: float = 60.0, tag: str = "serve",
                  prefix_cache: bool = False,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 role: str = "colocated"):
         if join_policy not in ("continuous", "static"):
             raise ValueError(f"unknown join_policy {join_policy!r} "
                              "(continuous|static)")
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r} "
+                             "(colocated|prefill|decode)")
         self._init_paged(model, params, ctx_max=ctx_max,
                          block_size=block_size, q_block=q_block,
                          decode_buckets=decode_buckets,
@@ -282,6 +296,19 @@ class ServeEngine(PagedModelRunner):
                     f"prefill_chunk must be a positive q_block="
                     f"{self.q_block} multiple, got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
+        # Disaggregated serving role (tony_tpu.serve.disagg): telemetry
+        # + router dispatch semantics. The engine itself stays fully
+        # capable whatever the role — a "decode" replica still prefills
+        # for itself on the colocated-fallback path, and "colocated"
+        # (the default) is byte-for-byte the PR 10/12/13 engine.
+        self.role = role
+        # Handoff counters (the widened heartbeat schema — zeros on
+        # colocated engines so the fleet schema stays uniform).
+        self.blocks_shipped = 0
+        self.handoff_ms = 0.0
+        self.imports_failed = 0
+        self.handoffs_out = 0
+        self.handoffs_in = 0
         self.keep_logits = keep_logits
         self.join_policy = join_policy
         self.tag = tag
@@ -330,7 +357,8 @@ class ServeEngine(PagedModelRunner):
                 max_running=self.max_running,
                 join_policy=self.join_policy,
                 prefix_cache=self.prefix_cache,
-                prefill_chunk=self.prefill_chunk)
+                prefill_chunk=self.prefill_chunk,
+                role=self.role)
 
     def expected_collectives(self) -> list:
         """The planner-registered expected collective set of the decode
@@ -484,15 +512,20 @@ class ServeEngine(PagedModelRunner):
         self._publish(seq)
 
     # -- scheduling --------------------------------------------------------
-    def _admit(self, req: Request) -> Tuple[int, int, Sequence[str]]:
+    def _admit(self, req: Request,
+               total: Optional[int] = None) -> Tuple[int, int, Sequence[str]]:
         """Reserve the request's full extent, adopting any published
         prefix blocks first; returns ``(start, matched, keys)`` — the
         prefill start position (past the adopted extent: those launches
         are simply never issued), the adopted block count, and the
         prompt's chain keys (so publication seeding never rehashes
         them). Raises :class:`AdmissionError` with the cache unchanged
-        on pool pressure, so a queued request retries whole."""
-        total = len(req.tokens) + req.max_new_tokens
+        on pool pressure, so a queued request retries whole. ``total``
+        overrides the reservation extent (the prefill-only mode
+        reserves the PROMPT alone — the decode extent belongs to the
+        replica that decodes)."""
+        if total is None:
+            total = len(req.tokens) + req.max_new_tokens
         if not self.prefix_cache:
             self.cache.reserve(req.rid, total)
             return 0, 0, ()
@@ -598,6 +631,243 @@ class ServeEngine(PagedModelRunner):
                 self._evict(seq, results)
             else:
                 self._running.append(seq)
+
+    # -- disaggregated prefill/decode (tony_tpu.serve.disagg) --------------
+    def prefill_only(self, req: Request) -> Dict[str, Any]:
+        """The prefill-role engine mode: run ``req``'s prompt through
+        the normal admission + prefill path (prefix adoption, the
+        chunked ``(1, chunk)`` launch family — the IDENTICAL program a
+        colocated engine runs, so the handoff cannot change a bit),
+        emit the FIRST token, then export the sequence's KV blocks as
+        the handoff wire payload and free the sequence — the output is
+        KV + one token, never a generation loop, and the engine is free
+        for the next prompt the moment this returns.
+
+        Single-driver contract: the caller (``serve.disagg.
+        PrefillFront``) holds the front's drive lock — the same lock
+        that serializes colocated ``generate`` callers — because every
+        line here mutates the paged pool."""
+        n = len(req.tokens)
+        if not req.tokens:
+            raise ValueError(f"request {req.rid!r}: empty prompt")
+        needed = self.cache.blocks_for(n)
+        if n > self.ctx_pad or needed > self.cache.n_blocks:
+            raise AdmissionError(
+                f"request {req.rid!r}: {n}-token prompt ({needed} "
+                f"blocks) > engine capacity (context {self.ctx_pad}, "
+                f"pool {self.cache.n_blocks} blocks)",
+                needed_blocks=needed,
+                free_blocks=self.cache.free_blocks, retryable=False)
+        start, matched, keys = self._admit(req, total=n)
+        seq = _Seq(req, time.monotonic())
+        seq.pf_pos = start
+        self._seed_publication(seq, matched, keys)
+        if self.prefill_chunk is not None:
+            while not self._prefill_chunk_step(seq):
+                pass
+        else:
+            self._prefill(seq)
+        first = int(seq.tokens[n])
+        # Chain keys of the full prompt blocks — the decode side's
+        # adoption probe AND its publication seed (always shipped:
+        # adoption on the importer works even when THIS engine runs
+        # with the prefix cache off).
+        wire_keys = (list(keys) if self.prefix_cache
+                     else prefix_mod.chain_keys(req.tokens,
+                                                self.block_size))
+        t_export = time.monotonic()
+        payload: Dict[str, Any] = {
+            "rid": req.rid,
+            "tokens": [int(t) for t in req.tokens],
+            "first_token": first,
+            "max_new_tokens": int(req.max_new_tokens),
+            "length": n,
+            "keys": wire_keys,
+            "blocks": self.cache.export_blocks(req.rid, n),
+            **self.cache.wire_header(),
+        }
+        if self.keep_logits:
+            payload["logits_b64"] = encode_f32(seq.logits[0])
+        # handoff_ms counts the time THIS engine spent moving KV bytes
+        # (export here, import on the decode side) — not the shipped
+        # sequence's downstream generation.
+        self.handoff_ms += 1e3 * (time.monotonic() - t_export)
+        self.cache.free_seq(req.rid)
+        # The prefill replica's ONLY load telemetry: a handoff never
+        # queues or joins the running batch, so without this event the
+        # gang would heartbeat qps=0/p99=0 forever — the per-gang
+        # autoscaler and the router's load scoring could never see a
+        # prefill burst. The event shape mirrors _evict's (latency from
+        # admission, one emitted token).
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, now - seq.t_submit, 1))
+        self._completed += 1
+        self._tokens_out += 1
+        return payload
+
+    def admit_handoff(self, payload: Dict[str, Any]
+                      ) -> Tuple[Any, Optional[Completion]]:
+        """The decode-role admission path: import a shipped prefill's
+        KV blocks into this engine's pool (:meth:`PagedKVCache.
+        import_blocks` — adopting any offered shared-prefix stem) and
+        join the sequence to the running batch with its prompt already
+        computed, so the next iteration decodes its second token exactly
+        where a colocated engine would. Returns ``(rid, completion)`` —
+        ``completion`` non-None only for the degenerate
+        ``max_new_tokens == 1`` handoff, whose one token the prefill
+        side already produced.
+
+        Back-pressure is a typed, state-unchanged rejection (the
+        shipper's retry surface): a full decode batch or an exhausted
+        pool raises :class:`AdmissionError` with NOTHING changed, and a
+        corrupt payload raises :class:`~tony_tpu.serve.disagg.
+        HandoffError` the same way. Single-driver contract: the caller
+        (``serve.disagg.DecodeFront``) holds the front's drive lock —
+        this runs on an RPC receiver thread while another thread drives
+        decode, which is exactly the mutation the PR 14 concurrency
+        plane gates."""
+        try:
+            try:
+                rid = payload["rid"]
+                tokens = [int(t) for t in payload["tokens"]]
+                max_new = int(payload["max_new_tokens"])
+                first = int(payload["first_token"])
+                offset = int(payload.get("offset", 0))
+            except (KeyError, TypeError, ValueError) as e:
+                # A version-skewed or truncated payload must reject the
+                # same way every other malformed field does — typed and
+                # counted — not escape as a bare KeyError past the
+                # shipper's _classify and the router's fallback split.
+                raise HandoffError(
+                    f"malformed handoff payload: missing or mistyped "
+                    f"field ({type(e).__name__}: {e})",
+                    retryable=False) from e
+            n = len(tokens)
+            if n != int(payload.get("length", n)) or not tokens \
+                    or max_new < 1:
+                raise HandoffError(
+                    f"malformed handoff for {rid!r}: length "
+                    f"{payload.get('length')} vs {n} prompt token(s), "
+                    f"max_new_tokens {max_new}", retryable=False)
+            header = self.cache.wire_header()
+            got = {k: payload.get(k) for k in header}
+            if got != header:
+                raise HandoffError(
+                    f"handoff geometry mismatch for {rid!r}: {got} vs "
+                    f"this pool's {header}", retryable=False)
+            total = n + max_new
+            needed = self.cache.blocks_for(total)
+            if total > self.ctx_pad or needed > self.cache.n_blocks:
+                raise AdmissionError(
+                    f"handoff {rid!r} needs {total} positions "
+                    f"({needed} blocks) > engine capacity (context "
+                    f"{self.ctx_pad}, pool {self.cache.n_blocks} "
+                    f"blocks); it can never be admitted",
+                    needed_blocks=needed,
+                    free_blocks=self.cache.free_blocks, retryable=False)
+            if self.running >= self.max_running:
+                raise AdmissionError(
+                    f"handoff {rid!r} rejected: decode batch full "
+                    f"({self.running}/{self.max_running} running)",
+                    needed_blocks=needed,
+                    free_blocks=self.cache.free_blocks)
+            # A shipped rid that is already live HERE (a caller-supplied
+            # duplicate — minted rids carry a per-front namespace) must
+            # reject typed before any import: admitting it would tear
+            # the front's rid-keyed completion routing, and the cache's
+            # own fresh-admission ValueError is not part of the
+            # (AdmissionError, HandoffError) failover split.
+            live = {s.rid for s in self._running} \
+                | {s.rid for s in self._prefilling} \
+                | set(self.cache.owned_blocks())
+            with self._lock:
+                live |= {r.rid for r, _ in self._queue}
+            if rid in live:
+                raise HandoffError(
+                    f"handoff rid {rid!r} collides with a live sequence "
+                    f"on this engine — rids must be unique fleet-wide",
+                    retryable=False)
+            # The shipped blocks (plus the adopted stem) must cover the
+            # prompt EXACTLY: a truncated or absent blocks field would
+            # otherwise pass every typed check — the per-block CRC only
+            # guards blocks that are present — and the uncovered prompt
+            # extent would decode from uninitialized pool blocks,
+            # silently wrong.
+            shipped = list(payload.get("blocks") or ())
+            if offset + len(shipped) != self.cache.blocks_for(n):
+                raise HandoffError(
+                    f"handoff {rid!r} blocks do not cover the prompt: "
+                    f"{offset} adopted + {len(shipped)} shipped != "
+                    f"{self.cache.blocks_for(n)} prompt block(s) for "
+                    f"{n} token(s)", retryable=False)
+            keys = [str(k) for k in payload.get("keys") or ()]
+            # The chain keys outlive this request — they index imported
+            # blocks into the SHARED prefix tier below — so unlike the
+            # CRC (which guards the wire, not content identity) they
+            # must be verified against the tokens they claim to cover:
+            # a version-skewed shipper's wrong keys would otherwise
+            # poison adoptions for unrelated future prompts, silently.
+            true_keys = prefix_mod.chain_keys(tokens, self.block_size)
+            if keys and keys != true_keys:
+                raise HandoffError(
+                    f"handoff chain keys for {rid!r} do not match the "
+                    f"shipped tokens ({len(keys)} key(s) vs "
+                    f"{len(true_keys)} derived) — key-scheme skew "
+                    f"between the gangs", retryable=False)
+            first_row: Optional[np.ndarray] = None
+            if self.keep_logits and payload.get("logits_b64"):
+                # Decode BEFORE the import mutates the pool: logits
+                # ride outside the per-block CRC, and a corrupt row
+                # must reject typed and state-unchanged like every
+                # other malformed field — not leak an admitted table.
+                try:
+                    first_row = decode_f32(payload["logits_b64"])
+                except (ValueError, TypeError) as e:
+                    raise HandoffError(
+                        f"malformed handoff logits for {rid!r}: {e}",
+                        retryable=False) from e
+            t_import = time.monotonic()
+            self.cache.import_blocks(rid, total, shipped, keys=keys,
+                                     offset=offset)
+            self.handoff_ms += 1e3 * (time.monotonic() - t_import)
+        except (AdmissionError, HandoffError):
+            self.imports_failed += 1
+            raise
+        seq = _Seq(Request(rid=rid, tokens=tokens,
+                           max_new_tokens=max_new), time.monotonic())
+        seq.pf_pos = n                     # the prompt arrived computed
+        seq.tokens.append(first)
+        seq.remaining -= 1                 # the prefill side emitted it
+        if first_row is not None:
+            seq.logits.append(first_row)
+        if self.prefix_cache and keys:
+            # The imported prompt blocks hold verified rows — index
+            # them under the shipped chain keys (adopted ones are
+            # already indexed; publish_block no-ops) and seed the
+            # publication cursor past them so decode publishes only
+            # what it computes.
+            for i, key in enumerate(keys):
+                self.cache.publish_block(rid, i, key)
+            seq.published = len(keys)
+            seq.hkey = keys[-1]
+        self.handoffs_in += 1
+        if seq.remaining <= 0:             # max_new_tokens == 1
+            done: List[Completion] = []
+            self._evict(seq, done)
+            return rid, done[0]
+        self._running.append(seq)
+        return rid, None
+
+    def note_handoff_shipped(self, blocks: int) -> None:
+        """Bank one completed outbound handoff's shipped-block count.
+        Called by the shipping front (``serve.disagg.PrefillFront``) —
+        possibly from CONCURRENT RPC receiver threads, the one handoff
+        counter path not serialized by the front's drive lock, hence
+        the engine lock here (a bare ``+=`` is a torn RMW)."""
+        with self._lock:
+            self.blocks_shipped += int(blocks)
+            self.handoffs_out += 1
 
     def step(self) -> List[Completion]:
         """One engine iteration: join what fits, advance one prefill
@@ -719,6 +989,16 @@ class ServeEngine(PagedModelRunner):
                 if self.prefix_lookup_blocks else 0.0),
             "blocks_shared": float(self.cache.adopted_total),
             "prefill_chunks": float(self.prefill_chunks),
+            # Disaggregated-serving telemetry (PR 15): the replica's
+            # role rides as a STRING (the schema's second non-scalar
+            # next to prefix_digest — normalize_serve_telemetry passes
+            # it through), the handoff counters as zeros on colocated
+            # engines so the fleet schema stays uniform and the router/
+            # autoscaler never branch on engine kind.
+            "role": self.role,
+            "blocks_shipped": float(self.blocks_shipped),
+            "handoff_ms": float(self.handoff_ms),
+            "imports_failed": float(self.imports_failed),
         }
         stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
@@ -806,18 +1086,33 @@ class EngineFront:
         self._drive = threading.Lock()
         self._done: Dict[Any, Completion] = {}
         self._rid = 0
+        # Minted rids cross replicas since the disaggregated handoff (a
+        # prefill front's rid lands on a decode engine that also mints
+        # its own), so a bare counter would collide routinely — every
+        # front mints in its own namespace.
+        self._rid_ns = uuid.uuid4().hex[:8]
         self._rid_lock = threading.Lock()
+
+    def fresh_rid(self) -> str:
+        with self._rid_lock:
+            self._rid += 1
+            return f"req-{self._rid_ns}-{self._rid}"
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
                  rid: Optional[Any] = None) -> Completion:
         """Submit one request and drive the shared engine until it
         completes."""
         if rid is None:
-            with self._rid_lock:
-                self._rid += 1
-                rid = f"req-{self._rid}"
+            rid = self.fresh_rid()
         self.engine.submit(Request(rid=rid, tokens=list(tokens),
                                    max_new_tokens=int(max_new_tokens)))
+        return self._drive_until(rid)
+
+    def _drive_until(self, rid: Any) -> Completion:
+        """Take turns advancing the shared loop until ``rid``'s
+        completion lands — the one drive discipline ``generate`` and
+        the disaggregated receiver (``serve.disagg.DecodeFront``, whose
+        handoff admissions join the same continuous batch) share."""
         while True:
             with self._drive:
                 if rid in self._done:

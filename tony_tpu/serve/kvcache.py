@@ -40,6 +40,14 @@ always FRESH (never adopted, never published while revocable), so a
 rollback can never strand a shared block: it returns exactly the
 private extension, and an adopted prefix below the cursor is untouched.
 
+Disaggregated serving (tony_tpu.serve.disagg) adds the wire tier:
+:meth:`~PagedKVCache.export_blocks` snapshots a sequence's blocks as
+CRC32-guarded payloads (the ckpt plane's chunk-checksum idiom) and
+:meth:`~PagedKVCache.import_blocks` is the receiving admission path —
+atomic like :meth:`~PagedKVCache.admit_shared` and composing with the
+prefix tier, so a shipped shared-prefix stem that the importer already
+holds is adopted instead of re-written.
+
 Capacity failures are a typed :class:`AdmissionError` carrying the
 needed/free block counts — an admission-control signal the engine (or a
 load balancer above it) can act on, categorically different from an
@@ -59,11 +67,15 @@ quiescent point.
 
 from __future__ import annotations
 
+import base64
+import zlib
 from collections import OrderedDict
 from typing import Any, Dict, List, Sequence
 
 import jax.numpy as jnp
 import numpy as np
+
+from tony_tpu.serve.disagg import HandoffError
 
 
 class AdmissionError(RuntimeError):
@@ -115,6 +127,9 @@ class PagedKVCache:
         self.cow_total = 0
         self.lru_evicted_total = 0
         self.revived_total = 0
+        # Disaggregated handoff (tony_tpu.serve.disagg): blocks whose
+        # bytes arrived over the wire via import_blocks.
+        self.imported_total = 0
         # Speculative tier (tony_tpu.serve.spec): per-sequence list of
         # blocks added by spec_reserve and not yet commit-promoted, plus
         # the write cursor — the highest position VERIFIED written (the
@@ -339,6 +354,156 @@ class PagedKVCache:
         """The LRU cached tier, least-recently-freed first (test
         surface for the partition + eviction-order invariants)."""
         return list(self._lru)
+
+    # -- disaggregated handoff (tony_tpu.serve.disagg) ---------------------
+    def wire_header(self) -> Dict[str, Any]:
+        """The geometry a block payload must match to be importable —
+        shipped with every handoff so a mis-paired fleet fails loudly
+        (typed) instead of gathering garbage."""
+        return {"n_layers": self.n_layers, "kv_dim": self.kv_dim,
+                "block_size": self.block_size,
+                "dtype": str(np.dtype(self.k.dtype))}
+
+    def export_blocks(self, seq_id: Any, length: int) -> List[Dict[str, Any]]:
+        """Wire payloads of the blocks covering ``length`` positions of
+        ``seq_id`` — per block, the raw ``[n_layers, block_size,
+        kv_dim]`` k and v bytes (base64 for the JSON-lines RPC) plus a
+        CRC32 over the concatenated raw bytes, the ckpt plane's
+        chunk-checksum idiom (:mod:`tony_tpu.ckpt.format`). Positions
+        past ``length`` inside the tail block ship as-is: stale bytes
+        are provably unread on the importer too (the same absolute-
+        position masking contract), so the CRC guards the WIRE, not
+        content identity. Read-only — no bookkeeping moves."""
+        table = self._tables[seq_id]
+        nb = self.blocks_for(length)
+        if nb > len(table):
+            raise ValueError(
+                f"cannot export {length} positions for {seq_id!r}: only "
+                f"{len(table)} block(s) reserved")
+        ids = np.asarray(table[:nb], np.int32)
+        # One device fetch each for k/v — not one per block.
+        kh = np.asarray(self.k[:, ids])
+        vh = np.asarray(self.v[:, ids])
+        out: List[Dict[str, Any]] = []
+        for i in range(nb):
+            kb = np.ascontiguousarray(kh[:, i]).tobytes()
+            vb = np.ascontiguousarray(vh[:, i]).tobytes()
+            out.append({
+                "k": base64.b64encode(kb).decode("ascii"),
+                "v": base64.b64encode(vb).decode("ascii"),
+                "crc": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF,
+            })
+        return out
+
+    def _decode_block(self, blk: Dict[str, Any]) -> tuple:
+        """Decode + CRC-verify one wire block payload into host
+        ``[n_layers, block_size, kv_dim]`` arrays; raises
+        :class:`~tony_tpu.serve.disagg.HandoffError` (non-retryable —
+        a resend of the same corrupt payload cannot heal it; the
+        SHIPPER owns transport retries) on any mismatch."""
+        try:
+            kb = base64.b64decode(blk["k"])
+            vb = base64.b64decode(blk["v"])
+            crc = int(blk["crc"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise HandoffError(f"malformed block payload: {e}",
+                               retryable=False) from e
+        if (zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF) != crc:
+            raise HandoffError(
+                f"block payload CRC mismatch (got "
+                f"{zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF:#010x}, "
+                f"want {crc:#010x})", retryable=False)
+        shape = (self.n_layers, self.block_size, self.kv_dim)
+        dt = np.dtype(self.k.dtype)
+        want = int(np.prod(shape)) * dt.itemsize
+        if len(kb) != want or len(vb) != want:
+            raise HandoffError(
+                f"block payload geometry mismatch: {len(kb)}/{len(vb)} "
+                f"bytes vs expected {want} for {shape} {dt}",
+                retryable=False)
+        return (np.frombuffer(kb, dt).reshape(shape),
+                np.frombuffer(vb, dt).reshape(shape))
+
+    def import_blocks(self, seq_id: Any, length: int,
+                      blocks: Sequence[Dict[str, Any]], *,
+                      keys: Sequence[str] = (), offset: int = 0) -> int:
+        """Fresh-admission import of a shipped prefill: adopt the first
+        ``offset`` blocks from the local prefix index via ``keys`` (the
+        receiver half of the offer/import handshake — a shipped
+        shared-prefix stem is adopted, never re-transferred), write the
+        shipped block payloads into freshly-allocated pool blocks, and
+        allocate the rest of the ``length``-covering reservation fresh.
+        Returns the number of blocks adopted.
+
+        Atomic like :meth:`admit_shared`: every raising check — payload
+        CRC/geometry, the offered prefix still matching, pool capacity —
+        runs BEFORE any bookkeeping or device byte moves, so an
+        :class:`AdmissionError` (pool pressure, retryable upstream) or
+        :class:`~tony_tpu.serve.disagg.HandoffError` leaves the cache
+        state-unchanged and the shipper retries whole. Imported blocks
+        are private (refcount 1) until the engine's write path touches
+        them; adopted blocks keep the COW contract — an import can never
+        mutate a shared block."""
+        if self._tables.get(seq_id):
+            raise ValueError(f"sequence {seq_id!r} already holds blocks "
+                             f"— import_blocks is a fresh-admission path")
+        offset = int(offset)
+        nb = self.blocks_for(length)
+        if offset < 0 or offset + len(blocks) > nb:
+            raise HandoffError(
+                f"import geometry mismatch: offset {offset} + "
+                f"{len(blocks)} shipped block(s) exceed the "
+                f"{nb}-block reservation for {length} positions",
+                retryable=False)
+        # 1. Decode + verify every payload (raises, nothing changed).
+        arrs = [self._decode_block(b) for b in blocks]
+        # 2. The offered prefix must still match — it can evaporate
+        #    between offer and import (LRU reclaim under pressure). The
+        #    CURRENT match count rides the error so the shipper re-ships
+        #    exactly the missing tail.
+        matched = self.match_prefix(list(keys)[:offset])
+        if len(matched) < offset:
+            raise HandoffError(
+                f"offered prefix evaporated: {len(matched)} of {offset} "
+                f"block(s) still indexed", matched=len(matched))
+        # 3. Capacity, revival-aware like admit_shared.
+        revive = sum(1 for b in matched if b in self._lru)
+        needed = nb - offset
+        if needed > self.free_blocks - revive:
+            raise AdmissionError(
+                f"KV pool exhausted: sequence {seq_id!r} needs {needed} "
+                f"fresh block(s) beyond {offset} adopted for {length} "
+                f"positions, {self.free_blocks - revive} available of "
+                f"{self.n_blocks}",
+                needed_blocks=needed,
+                free_blocks=self.free_blocks - revive)
+        # 4. Commit: adopt, then write the shipped bytes into fresh
+        #    blocks, then cover the tail.
+        for b in matched:
+            if b in self._lru:
+                del self._lru[b]
+                self._refs[b] = 1
+                self.revived_total += 1
+            else:
+                self._refs[b] += 1
+            self._touch_key(b)
+        self.adopted_total += len(matched)
+        table = list(matched)
+        if arrs:
+            dsts = [self._alloc_block() for _ in arrs]
+            # ONE batched scatter per pool, not one full-pool copy per
+            # block — the import is on the request latency path.
+            idx = jnp.asarray(dsts)
+            self.k = self.k.at[:, idx].set(
+                jnp.asarray(np.stack([a[0] for a in arrs], axis=1)))
+            self.v = self.v.at[:, idx].set(
+                jnp.asarray(np.stack([a[1] for a in arrs], axis=1)))
+            table.extend(dsts)
+        self.imported_total += len(arrs)
+        while len(table) < nb:
+            table.append(self._alloc_block())
+        self._tables[seq_id] = table
+        return len(matched)
 
     # -- speculative tier (tony_tpu.serve.spec) ----------------------------
     def committed_len(self, seq_id: Any) -> int:

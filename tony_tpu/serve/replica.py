@@ -41,7 +41,7 @@ from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
                            SERVE_MAX_RUNNING, SERVE_MESH, SERVE_MODEL,
                            SERVE_MODEL_KWARGS, SERVE_PORT,
                            SERVE_PREFILL_CHUNK, SERVE_PREFIX_CACHE,
-                           SERVE_SPEC_K)
+                           SERVE_SPEC_K, serve_role_key)
 from tony_tpu.serve.engine import Completion, EngineFront, ServeEngine
 
 
@@ -61,9 +61,11 @@ class Replica:
                  draft_ckpt_dir: Optional[str] = None,
                  ngram_max: int = 3,
                  prefix_cache: bool = False,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 role: str = "colocated"):
         from tony_tpu._trace import trace_record
         from tony_tpu.models import get_model
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
 
         self.model = get_model(model_name, **(model_kwargs or {}))
         self.mesh = mesh
@@ -94,23 +96,31 @@ class Replica:
                 max_running=max_running, mesh=mesh,
                 keep_logits=keep_logits, tag=tag,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-                **draft_kw)
+                role=role, **draft_kw)
         else:
             self.engine = ServeEngine(
                 self.model, params, ctx_max=ctx_max,
                 block_size=block_size, q_block=q_block, n_blocks=n_blocks,
                 max_running=max_running, mesh=mesh,
                 keep_logits=keep_logits, tag=tag,
-                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk)
+                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+                role=role)
         trace_record("serve", "replica", model=model_name,
                      ckpt_step=step, path_prefix=prefix,
                      dtype_policy=dtype_policy, spec_k=int(spec_k),
                      draft_model=draft_model_name or
                      ("ngram" if spec_k else None),
                      prefix_cache=bool(prefix_cache),
-                     prefill_chunk=prefill_chunk,
+                     prefill_chunk=prefill_chunk, role=role,
                      mesh_axes=dict(getattr(mesh, "shape", {}) or {}))
+        self.role = role
         self._front = EngineFront(self.engine)
+        # Disaggregated handoff halves (tony_tpu.serve.disagg). Every
+        # replica carries BOTH: the router's role-aware dispatch decides
+        # which verbs see traffic, and a colocated replica answering a
+        # stray kv_offer is harmless — capability is not policy.
+        self._prefill_front = PrefillFront(self._front)
+        self._decode_front = DecodeFront(self._front)
         self.port: Optional[int] = None
 
     @staticmethod
@@ -161,6 +171,22 @@ class Replica:
         same loop the router's in-process transport runs), so their
         requests ride one continuous batch."""
         return self._front.generate(tokens, max_new_tokens, rid=rid)
+
+    # -- disaggregated handoff (tony_tpu.serve.disagg) ---------------------
+    def prefill_handoff(self, tokens: Sequence[int], max_new_tokens: int,
+                        rid: Optional[Any] = None,
+                        decode: Any = None) -> Completion:
+        """Prefill-role request path: prefill ``tokens``, ship the KV
+        blocks to ``decode`` (an address or an in-process receiver),
+        return the completion the decode side drove to the end."""
+        return self._prefill_front.prefill_handoff(
+            tokens, max_new_tokens, rid=rid, decode=decode)
+
+    def kv_offer(self, keys: Sequence[str]) -> int:
+        return self._decode_front.kv_offer(keys)
+
+    def kv_import(self, payload: Dict[str, Any]) -> Completion:
+        return self._decode_front.kv_import(payload)
 
     # -- RPC front ---------------------------------------------------------
     def rpc_handler(self) -> "_ReplicaRpcHandler":
@@ -216,14 +242,38 @@ class _ReplicaRpcHandler:
     def __init__(self, replica: Replica):
         self.replica = replica
 
+    @staticmethod
+    def _wire(c: Completion) -> Dict[str, Any]:
+        return c.wire()
+
     def rpc_generate(self, tokens: List[int], max_new_tokens: int = 16,
                      rid: Optional[str] = None) -> Dict[str, Any]:
-        c = self.replica.generate(tokens, max_new_tokens, rid=rid)
-        return {"rid": c.rid, "tokens": c.tokens,
-                "latency_ms": round(1e3 * c.latency_s, 3)}
+        return self._wire(self.replica.generate(tokens, max_new_tokens,
+                                                rid=rid))
 
     def rpc_serve_stats(self) -> Dict[str, float]:
         return self.replica.engine.stats()
+
+    # -- disaggregated handoff verbs (tony_tpu.serve.disagg) ---------------
+    def rpc_prefill_handoff(self, tokens: List[int],
+                            max_new_tokens: int = 16,
+                            rid: Optional[str] = None,
+                            decode_address: Optional[str] = None
+                            ) -> Dict[str, Any]:
+        """The router's disaggregated dispatch verb: prefill here, ship
+        the KV replica-to-replica to ``decode_address``, return the
+        decode side's completion. Typed failures transport as
+        ``"HandoffError: ..."`` on the JSON-lines wire — the router
+        re-types them for its fallback split."""
+        out = self.replica.prefill_handoff(tokens, max_new_tokens,
+                                           rid=rid, decode=decode_address)
+        return out if isinstance(out, dict) else self._wire(out)
+
+    def rpc_kv_offer(self, keys: List[str]) -> int:
+        return self.replica.kv_offer(keys)
+
+    def rpc_kv_import(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        return self._wire(self.replica.kv_import(payload))
 
 
 def main() -> int:
@@ -251,6 +301,12 @@ def main() -> int:
     if mesh_kw:
         from tony_tpu import parallel as par
         mesh = par.MeshSpec(**json.loads(mesh_kw)).build()
+    # Disaggregated role: the executor exports the jobtype
+    # (TONY_JOB_NAME), the conf maps jobtype -> role — the per-jobtype
+    # role spec `tony serve --role` writes. A classic one-jobtype serve
+    # job has no role key and runs colocated.
+    job_type = os.environ.get(constants.ENV_JOB_NAME) or "serve"
+    role = conf.get(serve_role_key(job_type)) or "colocated"
     replica = Replica(
         model_name=model_name,
         model_kwargs=json.loads(conf.get(SERVE_MODEL_KWARGS) or "{}"),
@@ -267,7 +323,8 @@ def main() -> int:
         draft_ckpt_dir=conf.get(SERVE_DRAFT_CKPT_DIR),
         ngram_max=conf.get_int(SERVE_DRAFT_NGRAM_MAX, 3),
         prefix_cache=conf.get_bool(SERVE_PREFIX_CACHE, False),
-        prefill_chunk=conf.get_int(SERVE_PREFILL_CHUNK, 0) or None)
+        prefill_chunk=conf.get_int(SERVE_PREFILL_CHUNK, 0) or None,
+        role=role)
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

@@ -43,6 +43,19 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from tony_tpu.serve import prefix as prefix_mod
+from tony_tpu.serve.disagg import HandoffError
+
+
+def _wire_completion(out: Any, rid: Optional[Any]) -> Dict[str, Any]:
+    """Duck-typed completion -> wire dict, ONE definition for every
+    dispatch path (the router is jax-free, so it mirrors
+    ``engine.Completion.wire`` by shape instead of importing it). RPC
+    transports already return the dict."""
+    if isinstance(out, dict):
+        return out
+    return {"rid": getattr(out, "rid", rid),
+            "tokens": list(out.tokens),
+            "latency_ms": round(1e3 * out.latency_s, 3)}
 
 
 class NoReplicaError(RuntimeError):
@@ -88,6 +101,11 @@ class ReplicaView:
     running: float = 0.0
     p99_ms: float = 0.0
     digest: frozenset = frozenset()
+    # Disaggregated-serving role (tony_tpu.serve.disagg): "prefill" /
+    # "decode" replicas split the request into a prefill dispatch and a
+    # KV handoff target; "colocated" (every pre-PR 15 replica) serves
+    # whole requests.
+    role: str = "colocated"
     last_seen: float = 0.0
     alive: bool = True
     retired: bool = False
@@ -99,6 +117,9 @@ class ReplicaView:
         digest = stats.get("prefix_digest")
         if digest is not None:
             self.digest = frozenset(str(k) for k in digest)
+        role = stats.get("role")
+        if isinstance(role, str) and role:
+            self.role = role
         self.last_seen = now
         self.alive = True
 
@@ -153,6 +174,8 @@ class RequestRouter:
         self.failovers = 0
         self.affinity_hits = 0
         self.cache_routed = 0            # decisions won on overlap > 0
+        self.handoffs = 0                # disaggregated dispatches
+        self.handoff_fallbacks = 0       # handoff failed -> colocated
 
     # -- membership --------------------------------------------------------
     def upsert_replica(self, name: str, *, address: Optional[str] = None,
@@ -191,17 +214,22 @@ class RequestRouter:
                 view.retired = True
 
     def refresh_from_task_infos(self, infos: Sequence[Dict[str, Any]],
-                                *, job_type: str = "serve") -> None:
+                                *, job_type: Optional[str] = None) -> None:
         """Ingest the AM's ``get_task_infos`` wire form (or the
         ``serve_endpoints`` verb's output): live serve tasks whose
         heartbeat carried an ``rpc_port`` become routable replicas at
         ``host:rpc_port``; terminal tasks retire. One call wires the
         router to the whole elastic fleet — scale-ups appear, retired
-        replicas drain, no per-replica plumbing."""
+        replicas drain, no per-replica plumbing. ``job_type`` filters to
+        one jobtype; the default ingests every entry — a disaggregated
+        fleet's prefill and decode GANGS are separate jobtypes in one
+        job (the heterogeneous-gang wiring), and ``serve_endpoints``
+        already scopes its output to the serve-role jobtypes."""
         for info in infos:
-            if info.get("job_type", job_type) != job_type:
+            jt = info.get("job_type", job_type or "serve")
+            if job_type is not None and jt != job_type:
                 continue
-            name = f"{info.get('job_type', job_type)}:{info['index']}"
+            name = f"{jt}:{info['index']}"
             metrics = dict(info.get("serve_metrics") or {})
             terminal = info.get("status") in ("SUCCEEDED", "FAILED",
                                               "LOST", "KILLED")
@@ -225,7 +253,6 @@ class RequestRouter:
         """The replica name for one request — sticky affinity first
         (the session's history lives in that replica's prefix cache),
         then the policy score over live candidates."""
-        now = time.monotonic()
         keys = prefix_mod.chain_keys(tokens, self.block_size)
         with self._lock:
             if session_id is not None:
@@ -235,13 +262,7 @@ class RequestRouter:
                         and not pinned.retired:
                     self.affinity_hits += 1
                     return pinned.name
-            live = [v for v in self._replicas.values()
-                    if v.alive and not v.retired
-                    and now - v.last_seen <= self.policy.stale_s]
-            if not live:
-                # Stale-but-not-retired beats refusing outright.
-                live = [v for v in self._replicas.values()
-                        if v.alive and not v.retired]
+            live = self._live()
             if not live:
                 raise NoReplicaError(
                     f"no live replica among {len(self._replicas)} known")
@@ -253,6 +274,173 @@ class RequestRouter:
             if session_id is not None:
                 self._affinity[session_id] = best.name
             return best.name
+
+    # -- disaggregated routing (tony_tpu.serve.disagg) ---------------------
+    def _live(self) -> List[ReplicaView]:
+        """THE liveness filter — the one definition :meth:`route`,
+        :meth:`route_split`, and the split detection share, so the
+        colocated and disaggregated paths can never disagree on which
+        replicas are routable. Caller holds the lock."""
+        now = time.monotonic()
+        live = [v for v in self._replicas.values()
+                if v.alive and not v.retired
+                and now - v.last_seen <= self.policy.stale_s]
+        if not live:
+            live = [v for v in self._replicas.values()
+                    if v.alive and not v.retired]
+        return live
+
+    def _unpin(self, session_id: Any, name: str) -> None:
+        """Drop a session pin that references ``name`` (a plain sticky
+        pin or either half of a disaggregated pair). Takes the router
+        lock itself — call it OUTSIDE a held ``self._lock`` region (the
+        lock is not reentrant; the concurrency lint holds this module
+        to the discipline)."""
+        if session_id is None:
+            return
+        with self._lock:
+            pinned = self._affinity.get(session_id)
+            if pinned == name or (isinstance(pinned, tuple)
+                                  and name in pinned):
+                del self._affinity[session_id]
+
+    def route_split(self, tokens: Sequence[int],
+                    session_id: Optional[Any] = None) -> tuple:
+        """``(prefill_name, decode_name)`` for one disaggregated
+        dispatch, or ``(None, None)`` when the fleet has no live
+        prefill+decode split (the caller then runs the colocated PR 13
+        path unchanged). Prompts go to the prefill gang scored by
+        prefix overlap (the same policy score — a prefill replica's
+        published stem blocks are worth skipped launches); the handoff
+        target is the decode replica with the shallowest queue. Sticky
+        affinity pins the PAIR: the conversation's generated KV lives
+        on the decode replica, its prompt-stem blocks on the prefill
+        replica that computed them."""
+        with self._lock:
+            live = self._live()
+            if not (any(v.role == "prefill" for v in live)
+                    and any(v.role == "decode" for v in live)):
+                # The one split-detection site (dispatch relies on it):
+                # answered BEFORE the prompt is hashed, so a colocated
+                # fleet never pays chain_keys here.
+                return None, None
+        keys = prefix_mod.chain_keys(tokens, self.block_size)
+        with self._lock:
+            live = self._live()
+            prefills = [v for v in live if v.role == "prefill"]
+            decodes = [v for v in live if v.role == "decode"]
+            if not prefills or not decodes:
+                return None, None
+            if session_id is not None:
+                pinned = self._affinity.get(session_id)
+                if isinstance(pinned, tuple) and len(pinned) == 2:
+                    pf = self._replicas.get(pinned[0])
+                    dc = self._replicas.get(pinned[1])
+                    if pf in prefills and dc in decodes:
+                        self.affinity_hits += 1
+                        return pf.name, dc.name
+            best_pf = max(prefills,
+                          key=lambda v: (score(self.policy, v, keys),
+                                         v.name))
+            best_dc = min(decodes,
+                          key=lambda v: (v.queue_depth + v.running,
+                                         v.name))
+            if keys and best_pf.digest \
+                    and prefix_mod.match_overlap(keys, best_pf.digest):
+                self.cache_routed += 1
+            if session_id is not None:
+                self._affinity[session_id] = (best_pf.name, best_dc.name)
+            return best_pf.name, best_dc.name
+
+    def _decode_target(self, name: str) -> Any:
+        """What the prefill side ships to: the in-process client when
+        one is registered, the dialable ``host:port`` otherwise."""
+        with self._lock:
+            view = self._replicas[name]
+            return view.client if view.client is not None \
+                else view.address
+
+    def _dispatch_disagg(self, tokens: Sequence[int],
+                         max_new_tokens: int, *,
+                         session_id: Optional[Any],
+                         rid: Optional[Any],
+                         max_attempts: int) -> Dict[str, Any]:
+        """Prefill-gang dispatch + KV handoff, with the PR 13 failover
+        split kept intact: a TRANSPORT fault (``OSError`` family) marks
+        the replica down and re-dispatches; a typed
+        :class:`~tony_tpu.serve.disagg.HandoffError` (the decode pool
+        rejected the import after the shipper's bounded retries, or the
+        PREFILL pool was under transient pressure — prefill_only has no
+        queue to park the request in, so the shipper side re-types that
+        pressure) falls back to COLOCATED prefill on the decode replica — its engine
+        prefills for itself — so one slow importer costs this request a
+        fallback, never the prefill gang its throughput. Request-level
+        errors (AdmissionError/RpcError) still propagate untouched."""
+        last_err: Optional[Exception] = None
+        split_gone = False
+        for _ in range(max(1, int(max_attempts))):
+            pf, dc = self.route_split(tokens, session_id)
+            if pf is None:
+                # The split dissolved (possibly mid-retry — failovers
+                # drained a gang): the colocated path owns the rest,
+                # whatever already failed; whoever still serves can
+                # still take this request whole.
+                split_gone = True
+                break
+            try:
+                out = self._client_of(pf).prefill_handoff(
+                    [int(t) for t in tokens], int(max_new_tokens),
+                    rid=rid, decode=self._decode_target(dc))
+                with self._lock:
+                    self.handoffs += 1
+            except OSError as e:        # prefill transport fault
+                last_err = e
+                with self._lock:
+                    view = self._replicas.get(pf)
+                    if view is not None:
+                        view.alive = False
+                    self.failovers += 1
+                self._unpin(session_id, pf)
+                continue
+            except HandoffError as e:
+                last_err = e
+                with self._lock:
+                    self.handoff_fallbacks += 1
+                try:
+                    # A DISTINCT rid for the fallback generation: the
+                    # failed handoff may have half-landed (transport
+                    # died after the decode side committed the import),
+                    # and re-submitting the same rid to the same engine
+                    # would collide with the live sequence. The
+                    # caller's rid is restored on the response below.
+                    out = self._client_of(dc).generate(
+                        [int(t) for t in tokens], int(max_new_tokens),
+                        rid=None if rid is None else f"{rid}~fallback")
+                except OSError as e2:   # decode transport fault
+                    last_err = e2
+                    with self._lock:
+                        view = self._replicas.get(dc)
+                        if view is not None:
+                            view.alive = False
+                        self.failovers += 1
+                    self._unpin(session_id, dc)
+                    continue
+            with self._lock:
+                self.dispatched += 1
+            out = _wire_completion(out, rid)
+            if rid is not None:
+                out["rid"] = rid        # undo a ~fallback rewrite
+            out["replica"] = dc
+            out["prefill_replica"] = pf
+            return out
+        if split_gone:
+            return self._dispatch_colocated(tokens, max_new_tokens,
+                                            session_id=session_id,
+                                            rid=rid,
+                                            max_attempts=max_attempts)
+        raise NoReplicaError(
+            f"disaggregated dispatch failed after "
+            f"{max_attempts} attempt(s): {last_err}") from last_err
 
     def _client_of(self, name: str) -> Any:
         with self._lock:
@@ -274,7 +462,25 @@ class RequestRouter:
         prompt, an application ``RpcError``) propagate to the caller
         untouched: the replica is healthy, the REQUEST is bad, and
         down-marking on it would let one misbehaving client poison the
-        whole fleet."""
+        whole fleet.
+
+        Role-aware since PR 15: a fleet running the disaggregated
+        prefill/decode split dispatches prompt → prefill gang → KV
+        handoff → decode replica (:meth:`route_split`); a colocated
+        fleet (or a split that lost a whole gang) runs the PR 13 path
+        byte-for-byte unchanged."""
+        # route_split itself answers "is there a live split" — (None,
+        # None) sends _dispatch_disagg straight down the colocated
+        # path — so no separate pre-scan of the fleet is needed here.
+        return self._dispatch_disagg(
+            tokens, max_new_tokens, session_id=session_id, rid=rid,
+            max_attempts=max_attempts)
+
+    def _dispatch_colocated(self, tokens: Sequence[int],
+                            max_new_tokens: int, *,
+                            session_id: Optional[Any] = None,
+                            rid: Optional[Any] = None,
+                            max_attempts: int = 3) -> Dict[str, Any]:
         last_err: Optional[Exception] = None
         for _ in range(max(1, int(max_attempts))):
             name = self.route(tokens, session_id)
@@ -288,17 +494,12 @@ class RequestRouter:
                     view = self._replicas.get(name)
                     if view is not None:
                         view.alive = False
-                    if session_id is not None and \
-                            self._affinity.get(session_id) == name:
-                        del self._affinity[session_id]
                     self.failovers += 1
+                self._unpin(session_id, name)
                 continue
             with self._lock:
                 self.dispatched += 1
-            if not isinstance(out, dict):
-                out = {"rid": getattr(out, "rid", rid),
-                       "tokens": list(out.tokens),
-                       "latency_ms": round(1e3 * out.latency_s, 3)}
+            out = _wire_completion(out, rid)
             out["replica"] = name
             return out
         raise NoReplicaError(
@@ -317,15 +518,18 @@ class RequestRouter:
                 "failovers": float(self.failovers),
                 "affinity_hits": float(self.affinity_hits),
                 "cache_routed": float(self.cache_routed),
+                "handoffs": float(self.handoffs),
+                "handoff_fallbacks": float(self.handoff_fallbacks),
                 "sessions": float(len(self._affinity)),
             }
 
 
 def _rpc_dial(address: str, timeout: float) -> Any:
     """Default transport: the control-plane JSON-lines RPC client
-    against a replica's ``generate`` verb (lazy import — the RPC stack
-    only loads when a network replica is actually dialed)."""
-    from tony_tpu.rpc import RpcClient
+    against a replica's ``generate``/``prefill_handoff`` verbs (lazy
+    import — the RPC stack only loads when a network replica is
+    actually dialed)."""
+    from tony_tpu.rpc import RpcClient, RpcError
 
     class _Front:
         def generate(self, tokens, max_new_tokens, rid=None):
@@ -333,6 +537,24 @@ def _rpc_dial(address: str, timeout: float) -> Any:
                 return client.call("generate", tokens=tokens,
                                    max_new_tokens=max_new_tokens,
                                    rid=rid)
+
+        def prefill_handoff(self, tokens, max_new_tokens, rid=None,
+                            decode=None):
+            # ``decode`` crosses the wire as an address — the prefill
+            # REPLICA ships the fat KV payload replica-to-replica; the
+            # router only orchestrates. A transported HandoffError
+            # (the JSON-lines wire carries "<TypeName>: <message>")
+            # re-types so the router's fallback split keeps working
+            # over RPC exactly as in-process.
+            try:
+                with RpcClient(address, timeout=timeout) as client:
+                    return client.call("prefill_handoff", tokens=tokens,
+                                       max_new_tokens=max_new_tokens,
+                                       rid=rid, decode_address=decode)
+            except RpcError as e:
+                if str(e).startswith("HandoffError:"):
+                    raise HandoffError(str(e), retryable=False) from e
+                raise
 
     return _Front()
 

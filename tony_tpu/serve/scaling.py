@@ -27,7 +27,31 @@ from typing import Dict, Optional, Sequence
 
 from tony_tpu.conf import (SERVE_COOLDOWN_S, SERVE_P99_HIGH_MS,
                            SERVE_QUEUE_HIGH, SERVE_QUEUE_LOW,
-                           SERVE_REPLICAS_MAX, SERVE_REPLICAS_MIN)
+                           SERVE_REPLICAS_MAX, SERVE_REPLICAS_MIN,
+                           serve_replicas_max_key)
+
+
+def apportion_fleet_max(floors: Dict[str, int],
+                        fleet_max: int) -> Dict[str, int]:
+    """Per-gang autoscale ceilings from ONE fleet-wide
+    ``tony.serve.replicas.max``: every gang keeps its conf-declared
+    floor, and the headroom above the summed floors is split
+    proportionally to floor size (largest-remainder leftovers in
+    declaration order), so the per-gang ceilings can never sum past
+    the operator's fleet ceiling — a split fleet's prefill and decode
+    gangs must not each inflate to the whole budget."""
+    if not floors:
+        return {}
+    total = sum(floors.values())
+    head = max(0, int(fleet_max) - total)
+    out = {jt: n + head * n // total for jt, n in floors.items()}
+    rem = total + head - sum(out.values())
+    for jt in floors:
+        if rem <= 0:
+            break
+        out[jt] += 1
+        rem -= 1
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,13 +80,30 @@ class ScalingPolicy:
                 f"{self.queue_high} would oscillate")
 
     @classmethod
-    def from_conf(cls, conf, instances: int) -> "ScalingPolicy":
+    def from_conf(cls, conf, instances: int, *,
+                  job_type: Optional[str] = None,
+                  fleet_floors: Optional[Dict[str, int]] = None
+                  ) -> "ScalingPolicy":
         """Policy from job config; ``instances`` (the jobtype's static
         count) is the floor and the default ceiling — autoscale is OFF
-        unless the conf raises ``tony.serve.replicas.max`` above it."""
+        unless the conf raises ``tony.serve.replicas.max`` above it.
+
+        For a SPLIT fleet (``fleet_floors`` holds every serve
+        jobtype's static count) the global max is a fleet ceiling:
+        this gang's share comes from :func:`apportion_fleet_max`
+        unless ``tony.serve.replicas.max.<jobtype>`` overrides it —
+        otherwise two gangs would each scale to the whole budget and
+        the fleet could reach 2x the operator's ``--max_replicas``."""
+        mx = conf.get_int(SERVE_REPLICAS_MAX, instances)
+        if job_type is not None:
+            per = conf.get_int(serve_replicas_max_key(job_type), 0)
+            if per > 0:
+                mx = per
+            elif fleet_floors and len(fleet_floors) > 1:
+                mx = apportion_fleet_max(fleet_floors, mx)[job_type]
         return cls(
             min_replicas=conf.get_int(SERVE_REPLICAS_MIN, instances),
-            max_replicas=max(conf.get_int(SERVE_REPLICAS_MAX, instances),
+            max_replicas=max(mx,
                              conf.get_int(SERVE_REPLICAS_MIN, instances)),
             queue_high=conf.get_float(SERVE_QUEUE_HIGH, 8.0),
             queue_low=conf.get_float(SERVE_QUEUE_LOW, 1.0),

@@ -325,16 +325,35 @@ class TonySession:
                     if t.job_type == job_type and not t.status.is_terminal
                     and t.serve_metrics]
 
-    def serve_endpoints(self, job_type: str = "serve") -> List[Dict[str, object]]:
-        """Wire form of every replica of ``job_type`` that has reported
-        serving telemetry — what the request router
+    def serve_job_types(self) -> List[str]:
+        """Every jobtype serving traffic: the classic ``serve`` type
+        plus any jobtype carrying a ``tony.serve.role.<jobtype>`` conf
+        key (the disaggregated prefill/decode gangs — heterogeneous
+        jobtypes of ONE job, tony_tpu.serve.disagg)."""
+        from tony_tpu.conf import serve_role_key
+
+        out = []
+        for jt in self.conf.job_types():
+            if jt == constants.SERVE or self.conf.get(serve_role_key(jt)):
+                out.append(jt)
+        return out
+
+    def serve_endpoints(self, job_type: Optional[str] = None
+                        ) -> List[Dict[str, object]]:
+        """Wire form of every serving replica that has reported
+        telemetry — what the request router
         (:mod:`tony_tpu.serve.router`) ingests to track the elastic
         fleet: live replicas whose heartbeat carried an ``rpc_port``
         become routable at ``host:rpc_port``; terminal entries ride
-        along so the router retires them."""
+        along so the router retires them. ``job_type=None`` (the
+        default since the disaggregated split) spans every serve-role
+        jobtype, so one poll wires the router to the prefill AND decode
+        gangs; a named jobtype scopes to it."""
+        jts = [job_type] if job_type is not None \
+            else self.serve_job_types()
         with self.lock:
             return [t.to_info() for t in self._tasks.values()
-                    if t.job_type == job_type
+                    if t.job_type in jts
                     and (t.serve_metrics or t.status.is_terminal)]
 
     def last_committed_step(self) -> Optional[int]:

@@ -83,13 +83,22 @@ def normalize_serve_telemetry(raw: Dict) -> Dict[str, object]:
     """One normalization for the serve heartbeat schema, shared by the
     executor's stats-file reader and the session's heartbeat ingest so
     the two layers cannot drift: scalars become floats, list values
-    (the router's ``prefix_digest`` block-key list — the schema's one
-    non-scalar) become string lists. Raises on anything else, so both
-    callers keep their own advisory-telemetry failure handling."""
+    (the router's ``prefix_digest`` block-key list) become string
+    lists, and non-numeric strings (the disaggregated replica ``role``
+    — the schema's second non-scalar) pass through as strings. Numeric
+    strings still normalize to float, so a stats writer that
+    stringified a counter keeps its historical behavior. Raises on
+    anything else (dicts, None), so both callers keep their own
+    advisory-telemetry failure handling."""
     out: Dict[str, object] = {}
     for k, v in dict(raw).items():
         if isinstance(v, (list, tuple)):
             out[str(k)] = [str(x) for x in v]
+        elif isinstance(v, str):
+            try:
+                out[str(k)] = float(v)
+            except ValueError:
+                out[str(k)] = v
         else:
             out[str(k)] = float(v)
     return out
