@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -126,6 +126,18 @@ tier1-conc:
 # named leg is the lane's gate and must see them.
 tier1-disagg:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m disagg -p no:cacheprovider -p no:xdist -p no:randomly
+
+# KV-memory-hierarchy marker leg — the host-offload tier (demote/promote
+# with bytes verbatim, CRC-guarded host payloads, the extended
+# free/LRU/host partition), conversation parking pinned BITWISE vs a
+# never-parked engine (ragged lengths, prefix-cache/spec/disagg
+# composition), typed pool-pressure degrades, and the persistent prefix
+# store's stage-and-rename round trip + replica adoption. Runs the FULL
+# kvtier selection (slow included): the heavier parity sweeps are
+# slow-marked to keep tier1-verify inside its (tight — ROADMAP) 870 s
+# budget, but this named leg is the lane's gate and must see them.
+tier1-kvtier:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m kvtier -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Source lints, machine-checked: (1) the jnp.concatenate/stack pack-site
 # lint (the jax-0.4 GSPMD concat-reshard footgun) — every call site

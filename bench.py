@@ -295,6 +295,22 @@ def main() -> int:
             result["disagg_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
 
+    if os.environ.get("BENCH_KVTIER", "1") != "0":
+        # KV-memory-hierarchy leg (tony_tpu.serve PR 16): multi-turn
+        # conversations on the host-offload engine (park between
+        # turns, resume through the atomic import path) vs the
+        # recompute engine — turn-resume latency is the headline; the
+        # machine-independent claims are the prefill-row ledger (zero
+        # rows for the parked-covered extent), the park hit rate, and
+        # the bitwise token-identity gate. CPU wall numbers measure
+        # scheduling plus saved prefill compute (kvtier_sim_note);
+        # BENCH_r16.
+        try:
+            from tony_tpu.benchmark import run_kvtier_bench
+            result.update(run_kvtier_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["kvtier_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

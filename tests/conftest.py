@@ -52,6 +52,7 @@ _TIER_BY_MODULE = {
     "test_spec": "jit",
     "test_route": "jit",
     "test_disagg": "jit",
+    "test_kvtier": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

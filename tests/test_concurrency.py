@@ -703,10 +703,11 @@ class TestLockRegistry:
 # stress, now driven from N threads through the lock witness)
 # ---------------------------------------------------------------------------
 
-def _cache(n_blocks=16, block_size=4):
+def _cache(n_blocks=16, block_size=4, **kw):
     from tony_tpu.serve import PagedKVCache
 
-    return PagedKVCache(1, 4, n_blocks=n_blocks, block_size=block_size)
+    return PagedKVCache(1, 4, n_blocks=n_blocks, block_size=block_size,
+                        **kw)
 
 
 def _keys(tokens, bs=4):
@@ -718,7 +719,10 @@ def _keys(tokens, bs=4):
 def check_partition(c):
     """THE pool invariant (same as test_route's): free tier + cached
     tier + refcounted ownership partition the block ids, and every
-    refcount equals the number of tables holding the block."""
+    refcount equals the number of tables holding the block. With the
+    PR 16 host tier: host keys are disjoint from the device index (a
+    promoted or re-published key leaves the host shadow), the tier
+    stays inside its budget, and parked ids never alias live tables."""
     owned = {}
     for t in c.owned_blocks().values():
         for b in t:
@@ -729,6 +733,11 @@ def check_partition(c):
     assert free | lru | set(owned) == set(range(c.n_blocks))
     assert {b: c.ref(b) for b in owned} == owned
     assert set(c._refs) == set(owned)
+    assert not set(c.host_keys()) & set(c._index), \
+        "a chain key must live on exactly one tier"
+    assert c.host_blocks_used <= max(0, c.host_blocks)
+    assert not set(c.parked_ids()) & set(c.owned_blocks()), \
+        "a parked id must not alias a live table"
 
 
 @pytest.mark.slow
@@ -740,14 +749,15 @@ class TestThreadedKvcacheInterleave:
     def test_concurrent_interleave_partition_pinned(self, fresh_witness):
         """N threads hammer one shared pool with randomized
         admit/fork(shared-prefix)/write(COW)/spec(reserve-commit-
-        rollback)/evict under the witnessed pool lock; at every
-        quiescent point (a barrier each round) the refcount/free/LRU
-        partition is pinned exactly as the single-threaded PR 13
-        interleave pins it — and the witness graph of the run is
-        cycle-free."""
+        rollback)/evict — and, PR 16, demote/promote/park/resume
+        through the host tier — under the witnessed pool lock; at
+        every quiescent point (a barrier each round) the
+        refcount/free/LRU/host-tier partition is pinned exactly as the
+        single-threaded PR 13 interleave pins it — and the witness
+        graph of the run is cycle-free."""
         from tony_tpu.serve import AdmissionError
 
-        c = _cache(n_blocks=16, block_size=4)
+        c = _cache(n_blocks=16, block_size=4, host_blocks=8)
         pool_lock = conc.Lock("kvcache.pool")
         stats_lock = conc.Lock("kvcache.stats")
         stems = [list(np.random.RandomState(7).randint(0, 50, 8))
@@ -756,9 +766,10 @@ class TestThreadedKvcacheInterleave:
         errors = []
         stats = {"ops": 0, "admitted": 0}
 
-        def one_op(rng, tid, seqs, sid_n):
+        def one_op(rng, tid, seqs, parked, sid_n):
             op = rng.choice(["admit", "write", "spec", "free",
-                             "handoff"])
+                             "handoff", "demote", "promote", "park",
+                             "resume"])
             if op == "admit":
                 sid = f"t{tid}-s{sid_n[0]}"
                 sid_n[0] += 1
@@ -853,21 +864,109 @@ class TestThreadedKvcacheInterleave:
                         np.asarray(c.k[:, t_new[i]]), want_k), \
                         "imported block bytes must land verbatim"
                 seqs[sid] = list(toks[:exp_len])
+            elif op == "demote":
+                # PR 16 host tier: cold cached-tier blocks drop to host
+                # payloads; the pool partition below pins the books.
+                c.demote(rng.randint(1, 4))
+            elif op == "promote" and c.host_keys():
+                from tony_tpu.serve import HandoffError
+
+                hk = c.host_keys()
+                key = hk[rng.randint(len(hk))]
+                payload = dict(c._host_index[key])
+                # The corruption probe needs a free slot: with the LIFO
+                # tier empty promote degrades to 0 BEFORE decoding (by
+                # design — it never allocates through LRU eviction), so
+                # the poison would go untested and leak to a later op.
+                if rng.rand() < 0.25 and c._free:
+                    # Seeded host-tier corruption: promote must reject
+                    # typed with BOTH tiers unchanged (the partition
+                    # check each round pins "unchanged"), and the
+                    # poison entry discards cleanly.
+                    before_free = list(c._free)
+                    c._host_index[key]["crc"] ^= 1
+                    try:
+                        c.promote([key])
+                        raise AssertionError("corrupt promote accepted")
+                    except HandoffError:
+                        pass
+                    assert list(c._free) == before_free
+                    assert c.discard_host([key]) == 1
+                    return
+                if c.promote([key]):
+                    b = c._index[key]
+                    want_k, want_v = c._decode_block(payload)
+                    assert np.array_equal(np.asarray(c.k[:, b]),
+                                          want_k) \
+                        and np.array_equal(np.asarray(c.v[:, b]),
+                                           want_v), \
+                        "demoted bytes must promote back verbatim"
+            elif op == "park" and seqs:
+                sid = list(seqs)[rng.randint(len(seqs))]
+                toks = seqs[sid]
+                length = rng.randint(1, len(toks) + 1)
+                try:
+                    c.park(sid, length,
+                           keys=_keys(toks)[:length // c.block_size])
+                except AdmissionError:
+                    return          # host tier full: plain evict path
+                del seqs[sid]
+                c.free_seq(sid)     # park already freed: idempotent 0
+                pid = f"t{tid}-p{sid_n[0]}"
+                sid_n[0] += 1
+                parked[pid] = (sid, length, list(toks))
+            elif op == "resume" and parked:
+                from tony_tpu.serve import HandoffError
+
+                pid = list(parked)[rng.randint(len(parked))]
+                old_sid, length, toks = parked[pid]
+                rec = c._parked[old_sid]
+                rec["ready"].wait()
+                # The probe must poison a block the resume will DECODE:
+                # a stem block still published on device (another
+                # thread's copy of the shared stem) is adopted without
+                # touching its host payload, so corrupting it proves
+                # nothing — match the prefix under the same lock the
+                # resume will and corrupt the first decoded block.
+                m = len(c.match_prefix(rec["keys"]))
+                if rng.rand() < 0.25 and m < len(rec["blocks"]):
+                    # Seeded CRC corruption on a parked payload: the
+                    # resume must reject typed and state-unchanged —
+                    # record intact, pool untouched — then restore.
+                    rec["blocks"][m]["crc"] ^= 1
+                    try:
+                        c.resume(f"t{tid}-x", length + 4, old_sid)
+                        raise AssertionError("corrupt resume accepted")
+                    except HandoffError:
+                        pass
+                    assert old_sid in c._parked
+                    rec["blocks"][m]["crc"] ^= 1
+                    return
+                sid = f"t{tid}-r{sid_n[0]}"
+                sid_n[0] += 1
+                try:
+                    c.resume(sid, length + 4, old_sid)
+                except AdmissionError:
+                    return          # record kept: retryable next round
+                del parked[pid]
+                seqs[sid] = list(toks[:length])
 
         def worker(tid):
             rng = np.random.RandomState(100 + tid)
-            seqs, sid_n = {}, [0]
+            seqs, parked, sid_n = {}, {}, [0]
             try:
                 for _ in range(self.ROUNDS):
                     for _ in range(self.OPS_PER_ROUND):
                         with pool_lock:
-                            one_op(rng, tid, seqs, sid_n)
+                            one_op(rng, tid, seqs, parked, sid_n)
                             stats["ops"] += 1
                     barrier.wait()          # quiescent point reached
                     barrier.wait()          # main finished the check
                 with pool_lock:
                     for sid in list(seqs):
                         c.free_seq(sid)
+                    for _, (old_sid, _, _) in parked.items():
+                        c.unpark(old_sid)
             except Exception as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
                 barrier.abort()
@@ -878,9 +977,14 @@ class TestThreadedKvcacheInterleave:
         for t in threads:
             t.start()
         for _ in range(self.ROUNDS):
-            barrier.wait()
-            check_partition(c)              # every quiescent point
-            barrier.wait()
+            # A worker failure aborts the barrier: fall through to the
+            # error assert below, which names the REAL exception.
+            try:
+                barrier.wait()
+                check_partition(c)          # every quiescent point
+                barrier.wait()
+            except threading.BrokenBarrierError:
+                break
         for t in threads:
             t.join(timeout=30)
         assert not errors, errors
@@ -891,6 +995,10 @@ class TestThreadedKvcacheInterleave:
             "the interleave must actually exercise sharing and COW"
         assert c.imported_total > 0, \
             "the interleave must actually exercise the handoff wire tier"
+        assert c.demoted_total > 0 and c.promoted_total > 0, \
+            "the interleave must actually exercise the host tier"
+        assert c.parked_total > 0 and c.resumed_total > 0, \
+            "the interleave must actually exercise park/resume"
         assert stats["ops"] == self.N_THREADS * self.ROUNDS \
             * self.OPS_PER_ROUND
         # The witness watched the whole run: the pool->stats edge was

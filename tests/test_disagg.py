@@ -622,7 +622,7 @@ class TestHandoffFailure:
 
         class DeadPrefill:
             def prefill_handoff(self, tokens, max_new_tokens, rid=None,
-                                decode=None):
+                                decode=None, conv=None):
                 raise ConnectionRefusedError("replica gone")
 
         pf_eng = make_engine(tiny, role="prefill")
@@ -728,7 +728,7 @@ class TestRouterRoles:
 
         class DeadPrefill:
             def prefill_handoff(self, tokens, max_new_tokens, rid=None,
-                                decode=None):
+                                decode=None, conv=None):
                 raise ConnectionRefusedError("gang gone")
 
         dc_eng = make_engine(tiny, role="decode")
@@ -985,14 +985,16 @@ class TestDisaggOverRpc:
                 self._prefill_front = PrefillFront(self._front)
                 self._decode_front = DecodeFront(self._front)
 
-            def generate(self, tokens, max_new_tokens, rid=None):
+            def generate(self, tokens, max_new_tokens, rid=None,
+                         conv=None):
                 return self._front.generate(tokens, max_new_tokens,
-                                            rid=rid)
+                                            rid=rid, conv=conv)
 
             def prefill_handoff(self, tokens, max_new_tokens, rid=None,
-                                decode=None):
+                                decode=None, conv=None):
                 return self._prefill_front.prefill_handoff(
-                    tokens, max_new_tokens, rid=rid, decode=decode)
+                    tokens, max_new_tokens, rid=rid, decode=decode,
+                    conv=conv)
 
             def kv_offer(self, keys):
                 return self._decode_front.kv_offer(keys)

@@ -1,0 +1,673 @@
+"""KV-memory-hierarchy legs (tony_tpu.serve PR 16): the host-offload
+tier (demote/promote with bytes verbatim, CRC-guarded host payloads,
+the extended free/LRU/host partition), conversation parking pinned
+BITWISE vs a never-parked engine (ragged lengths, prefix-cache / spec /
+disagg composition, typed pool-pressure degrades that never wedge), and
+the persistent prefix store (stage-and-rename commit, engine/replica
+stem adoption)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.kvtier
+
+
+# ---------------------------------------------------------------------------
+# Shared tiny model + params (built once; serving is read-only on params).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    import flax.linen as nn
+
+    from tony_tpu.models import get_model
+
+    model = get_model("llama-tiny", n_layers=2)
+    sample = jnp.zeros((1, 16), jnp.int32)
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), sample))["params"]
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        params)
+    return model, params
+
+
+def make_engine(tiny, **kw):
+    from tony_tpu.serve import ServeEngine
+
+    model, params = tiny
+    kw.setdefault("ctx_max", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("q_block", 16)
+    kw.setdefault("decode_buckets", (2, 4))
+    kw.setdefault("max_running", 4)
+    kw.setdefault("keep_logits", True)
+    return ServeEngine(model, params, **kw)
+
+
+def assert_bitwise(got, ref, what):
+    assert got.tokens == ref.tokens, f"{what}: token streams differ"
+    assert got.logits is not None and ref.logits is not None
+    assert len(got.logits) == len(ref.logits)
+    for j, (g, r) in enumerate(zip(got.logits, ref.logits)):
+        assert np.array_equal(g, r), (
+            f"{what}: logits row {j} differs "
+            f"(max abs diff {np.max(np.abs(np.asarray(g) - np.asarray(r)))})")
+
+
+def run_conversation(eng, turns, conv, max_new=4):
+    """Drive a multi-turn conversation: each turn's prompt is the FULL
+    history (prior prompt + generated tokens) plus the new user tokens —
+    the chat-completion wire shape. Returns the per-turn completions."""
+    from tony_tpu.serve import EngineFront
+
+    front = EngineFront(eng)
+    history: list = []
+    outs = []
+    for t in turns:
+        prompt = history + [int(x) for x in t]
+        kw = {} if conv is None else {"conv": conv}
+        c = front.generate(prompt, max_new, **kw)
+        outs.append(c)
+        history = prompt + list(c.tokens)
+    return outs
+
+
+def cache_snapshot(c):
+    return (dict(c._refs), list(c._free), c.cached_blocks(),
+            {s: list(t) for s, t in c.owned_blocks().items()},
+            list(c.host_keys()), list(c.parked_ids()))
+
+
+def check_partition(c):
+    """The pool partition, host tier included: free + cached + owned
+    cover the device ids exactly; host keys never shadow device keys;
+    parked ids never alias live tables."""
+    owned = {}
+    for t in c.owned_blocks().values():
+        for b in t:
+            owned[b] = owned.get(b, 0) + 1
+    free, lru = set(c._free), set(c.cached_blocks())
+    assert not free & lru
+    assert not (free | lru) & set(owned)
+    assert free | lru | set(owned) == set(range(c.n_blocks))
+    assert not set(c.host_keys()) & set(c._index)
+    assert c.host_blocks_used <= max(0, c.host_blocks)
+    assert not set(c.parked_ids()) & set(c.owned_blocks())
+
+
+# ---------------------------------------------------------------------------
+# Host tier: demote / promote / park / resume at the pool level
+# ---------------------------------------------------------------------------
+
+class TestHostTier:
+    def _pool(self, n_blocks=8, block_size=4, host_blocks=8, **kw):
+        from tony_tpu.serve import PagedKVCache
+
+        return PagedKVCache(2, 8, n_blocks=n_blocks,
+                            block_size=block_size,
+                            host_blocks=host_blocks, **kw)
+
+    def _keys(self, tokens, bs=4):
+        from tony_tpu.serve import prefix
+
+        return prefix.chain_keys(tokens, bs)
+
+    def _publish(self, c, sid, tokens):
+        keys = self._keys(tokens, c.block_size)
+        c.admit_shared(sid, len(tokens), keys)
+        for i, key in enumerate(keys):
+            c.write_index(sid, i * c.block_size)
+            c.publish_block(sid, i, key)
+        return keys
+
+    def test_demote_promote_round_trip_bytes_verbatim(self):
+        c = self._pool()
+        toks = list(range(8))
+        keys = self._publish(c, "a", toks)
+        c.free_seq("a")                    # refcount-0 cached tier
+        assert c.cached_blocks()
+        # Capture device bytes before demotion for the verbatim check.
+        before = {k: (np.asarray(c.k[:, c._index[k]]),
+                      np.asarray(c.v[:, c._index[k]])) for k in keys}
+        assert c.demote(len(keys)) == len(keys)
+        assert set(c.host_keys()) == set(keys)
+        assert c.demoted_total == len(keys)
+        assert not set(keys) & set(c._index), \
+            "a demoted key must leave the device index"
+        check_partition(c)
+        # Promotion re-stages the chain and the bytes come back verbatim.
+        assert c.promote(keys) == len(keys)
+        assert c.host_keys() == []
+        assert c.promoted_total == len(keys)
+        for k in keys:
+            b = c._index[k]
+            assert np.array_equal(np.asarray(c.k[:, b]), before[k][0])
+            assert np.array_equal(np.asarray(c.v[:, b]), before[k][1])
+        # Promoted blocks sit refcount-0 in the cached tier: a shared
+        # admission adopts them like any published stem.
+        assert c.match_prefix(keys) and len(c.match_prefix(keys)) == \
+            len(keys)
+        check_partition(c)
+
+    def test_promote_consumes_lifo_tier_only(self):
+        """Promotion under device pressure degrades (truncates to the
+        free list) instead of allocating through LRU eviction — which
+        could evict, or re-demote, the very chain being promoted."""
+        c = self._pool(n_blocks=4, block_size=4)
+        keys = self._publish(c, "a", list(range(8)))   # 2 blocks
+        c.free_seq("a")
+        assert c.demote(2) == 2
+        c.reserve("hog", 16)               # all 4 device blocks owned
+        assert c.promote(keys) == 0, \
+            "no free block: promote must degrade, not evict"
+        assert set(c.host_keys()) == set(keys)
+        c.free_seq("hog")
+        assert c.promote(keys) == 2
+        check_partition(c)
+
+    def test_host_crc_corruption_rejected_state_unchanged(self):
+        from tony_tpu.serve import HandoffError
+
+        c = self._pool()
+        keys = self._publish(c, "a", list(range(8)))
+        c.free_seq("a")
+        c.demote(len(keys))
+        c._host_index[keys[0]]["crc"] ^= 1
+        snap = cache_snapshot(c)
+        with pytest.raises(HandoffError) as ei:
+            c.promote(keys)
+        assert not ei.value.retryable
+        assert cache_snapshot(c) == snap, \
+            "a corrupt host payload must reject with BOTH tiers unchanged"
+        # The poison entry discards cleanly; the chain recomputes fresh.
+        assert c.discard_host(keys) == len(keys)
+        assert c.host_keys() == []
+
+    def test_host_tier_budget_reclaims_stems_never_parked(self):
+        c = self._pool(n_blocks=12, block_size=4, host_blocks=3)
+        keys = self._publish(c, "a", list(range(8)))   # 2 stem blocks
+        c.free_seq("a")
+        assert c.demote(2) == 2
+        c.reserve("p", 12)                             # 3 blocks
+        # Parking 3 blocks forces the 2 stems out (they are the only
+        # legal victims) — and a SECOND park must then fail typed.
+        from tony_tpu.serve import AdmissionError
+
+        assert c.park("p", 12, keys=self._keys(list(range(12)))) == 3
+        assert c.host_keys() == [], "stems are the reclaim victims"
+        assert c.host_blocks_used == 3
+        c.reserve("q", 4)
+        with pytest.raises(AdmissionError) as ei:
+            c.park("q", 4, keys=self._keys(list(range(4))))
+        assert ei.value.retryable
+        assert "q" in c.owned_blocks(), "failed park leaves the seq live"
+        assert "p" in c.parked_ids()
+        del keys
+
+    def test_park_resume_round_trip_sync(self):
+        c = self._pool()
+        toks = list(range(10))             # 2 full blocks + partial tail
+        keys = self._keys(toks)[:2]
+        c.reserve("s", 12)
+        for i in range(3):
+            c.write_index("s", i * 4)
+        want = [(np.asarray(c.k[:, b]), np.asarray(c.v[:, b]))
+                for b in c.table("s")]
+        assert c.park("s", 10, keys=keys) == 3
+        assert "s" not in c.owned_blocks()
+        assert c.parked_ids() == ["s"]
+        check_partition(c)
+        adopted = c.resume("s2", 14, "s")
+        assert c.parked_ids() == []
+        t = c.table("s2")
+        for i in range(3):
+            assert np.array_equal(np.asarray(c.k[:, t[i]]), want[i][0])
+            assert np.array_equal(np.asarray(c.v[:, t[i]]), want[i][1])
+        assert c.parked_total == 1 and c.resumed_total == 1
+        assert adopted >= 0
+        check_partition(c)
+
+    def test_park_async_offload_worker_and_close(self):
+        """The async double-buffer path: encode happens off-thread, the
+        ready event gates the resume, and close() joins the worker (the
+        thread-hygiene contract the conftest guard polices)."""
+        c = self._pool(async_offload=True)
+        try:
+            assert any(t.name == "tony-kv-offload"
+                       for t in threading.enumerate())
+            toks = list(range(8))
+            c.reserve("s", 8)
+            for i in range(2):
+                c.write_index("s", i * 4)
+            want = [(np.asarray(c.k[:, b]), np.asarray(c.v[:, b]))
+                    for b in c.table("s")]
+            c.park("s", 8, keys=self._keys(toks))
+            c.resume("s2", 12, "s")        # waits on the ready event
+            t = c.table("s2")
+            for i in range(2):
+                assert np.array_equal(np.asarray(c.k[:, t[i]]),
+                                      want[i][0])
+        finally:
+            c.close()
+        assert not any(t.name == "tony-kv-offload"
+                       for t in threading.enumerate())
+
+    def test_parked_crc_corruption_rejected_record_kept(self):
+        from tony_tpu.serve import HandoffError
+
+        c = self._pool()
+        c.reserve("s", 8)
+        for i in range(2):
+            c.write_index("s", i * 4)
+        c.park("s", 8, keys=self._keys(list(range(8))))
+        rec = c._parked["s"]
+        rec["blocks"][0]["crc"] ^= 1
+        snap = cache_snapshot(c)
+        with pytest.raises(HandoffError):
+            c.resume("s2", 12, "s")
+        assert cache_snapshot(c) == snap, \
+            "a corrupt resume must leave pool AND record unchanged"
+        rec["blocks"][0]["crc"] ^= 1       # restore: record still good
+        assert c.resume("s2", 12, "s") >= 0
+
+    def test_park_tier_off_typed_state_unchanged(self):
+        from tony_tpu.serve import AdmissionError
+
+        c = self._pool(host_blocks=0)
+        c.reserve("s", 8)
+        snap = cache_snapshot(c)
+        with pytest.raises(AdmissionError):
+            c.park("s", 8, keys=self._keys(list(range(8))))
+        assert cache_snapshot(c) == snap
+
+    def test_park_validates_geometry(self):
+        c = self._pool()
+        c.reserve("s", 8)
+        with pytest.raises(ValueError):
+            c.park("s", 8, keys=[])        # needs 2 chain keys
+        with pytest.raises(ValueError):
+            c.park("s", 99, keys=[])       # beyond the held extent
+        with pytest.raises(KeyError):
+            c.resume("x", 8, "never-parked")
+        assert c.unpark("never-parked") == 0
+
+
+# ---------------------------------------------------------------------------
+# Conversation parking: bitwise parity vs a never-parked engine
+# ---------------------------------------------------------------------------
+
+class TestParkingParity:
+    def test_two_turn_resume_bitwise_and_counted(self, tiny):
+        """The core contract: turn 2 of a parked conversation resumes
+        from the host tier — zero prefill launches for the shared
+        history — and its token stream AND per-token logits are bitwise
+        identical to a never-parked engine's."""
+        parked = make_engine(tiny, host_blocks=64)
+        plain = make_engine(tiny)
+        rng = np.random.RandomState(21)
+        turns = [list(rng.randint(0, 256, 11)),
+                 list(rng.randint(0, 256, 5))]
+        got = run_conversation(parked, turns, conv="c1")
+        ref = run_conversation(plain, turns, conv=None)
+        for g, r in zip(got, ref):
+            assert_bitwise(g, r, "two-turn parked vs never-parked")
+        assert parked.park_hits == 1 and parked.park_lookups == 2
+        s = parked.stats()
+        assert s["park_hit_rate"] == 0.5
+        assert s["parked_seqs"] == 1.0      # turn 2 re-parked on finish
+        assert parked.parked_digest() == ["c1"]
+        # The resumed turn skipped the shared-history prefill rows.
+        assert parked.prefill_rows < plain.prefill_rows
+
+    @pytest.mark.slow
+    def test_park_resume_bitwise_ragged_lengths(self, tiny):
+        """Ragged turn-1 lengths around the block/row-block boundaries:
+        7/8/9/15/17 — partial tail blocks, exact block fits, and the
+        q_block boundary all park and resume bitwise."""
+        rng = np.random.RandomState(22)
+        for n in (7, 8, 9, 15, 17):
+            parked = make_engine(tiny, host_blocks=64)
+            plain = make_engine(tiny)
+            turns = [list(rng.randint(0, 256, n)),
+                     list(rng.randint(0, 256, 4))]
+            got = run_conversation(parked, turns, conv=f"c{n}")
+            ref = run_conversation(plain, turns, conv=None)
+            for g, r in zip(got, ref):
+                assert_bitwise(g, r, f"ragged turn-1 length {n}")
+            assert parked.park_hits == 1, f"length {n} must resume"
+            parked.cache.close()
+
+    def test_three_turn_conversation_reparks(self, tiny):
+        parked = make_engine(tiny, host_blocks=64)
+        plain = make_engine(tiny)
+        rng = np.random.RandomState(23)
+        turns = [list(rng.randint(0, 256, 9)),
+                 list(rng.randint(0, 256, 3)),
+                 list(rng.randint(0, 256, 5))]
+        got = run_conversation(parked, turns, conv="c3", max_new=3)
+        ref = run_conversation(plain, turns, conv=None, max_new=3)
+        for g, r in zip(got, ref):
+            assert_bitwise(g, r, "three-turn conversation")
+        assert parked.park_hits == 2
+
+    def test_diverged_turn_drops_record_and_reprefills(self, tiny):
+        """An edited conversation (the second turn does not extend the
+        parked history) must drop the record and admit fresh — correct
+        output, no resume, no leak."""
+        parked = make_engine(tiny, host_blocks=64)
+        plain = make_engine(tiny)
+        rng = np.random.RandomState(24)
+        t1 = list(rng.randint(0, 256, 9))
+        run_conversation(parked, [t1], conv="d1")
+        edited = list(rng.randint(0, 256, 13))
+        edited[0] = (t1[0] + 1) % 256               # not an extension
+        from tony_tpu.serve import EngineFront
+
+        got = EngineFront(parked).generate(edited, 4, conv="d1")
+        ref = EngineFront(plain).generate(edited, 4)
+        assert_bitwise(got, ref, "diverged turn")
+        assert parked.park_hits == 0
+        assert parked.cache.resumed_total == 0
+
+    def test_park_composes_with_prefix_cache_shared_stem(self, tiny):
+        """Parking + prefix caching: the resumed turn's blocks publish
+        back into the prefix tier, a SECOND conversation sharing the
+        stem adopts them (no COW, no stranded published block), and
+        both stay bitwise vs prefix-only engines."""
+        parked = make_engine(tiny, host_blocks=64, prefix_cache=True)
+        plain = make_engine(tiny, prefix_cache=True)
+        rng = np.random.RandomState(25)
+        stem = list(rng.randint(0, 256, 8))
+        turns = [stem + list(rng.randint(0, 256, 3)),
+                 list(rng.randint(0, 256, 4))]
+        got = run_conversation(parked, turns, conv="p1")
+        ref = run_conversation(plain, turns, conv=None)
+        for g, r in zip(got, ref):
+            assert_bitwise(g, r, "parked+prefix vs prefix-only")
+        # A second conversation over the same stem adopts the published
+        # blocks on BOTH engines — sharing stays shared through a park.
+        t2 = [stem + list(rng.randint(0, 256, 5))]
+        got2 = run_conversation(parked, t2, conv="p2")
+        ref2 = run_conversation(plain, t2, conv=None)
+        assert_bitwise(got2[0], ref2[0], "second conv over shared stem")
+        assert parked.prefix_hit_blocks > 0
+        check_partition(parked.cache)
+        # Nothing strands: dropping every parked record and the cached
+        # tier returns the whole pool.
+        for conv in list(parked._parked):
+            rec = parked._parked.pop(conv)
+            parked.cache.unpark(rec["rid"])
+        assert parked.cache.free_blocks == parked.cache.n_blocks
+
+    def test_spec_engine_parks_and_resumes_bitwise(self, tiny):
+        """The speculative lane rides the host tier through the same
+        ctor kwargs; greedy parity holds across a park/resume."""
+        from tony_tpu.serve import SpecEngine
+
+        model, params = tiny
+        spec = SpecEngine(model, params, spec_k=2, ctx_max=64,
+                          block_size=8, q_block=16, decode_buckets=(2, 4),
+                          max_running=4, keep_logits=True,
+                          host_blocks=64)
+        plain = make_engine(tiny)
+        rng = np.random.RandomState(26)
+        turns = [list(rng.randint(0, 256, 9)),
+                 list(rng.randint(0, 256, 4))]
+        got = run_conversation(spec, turns, conv="s1")
+        ref = run_conversation(plain, turns, conv=None)
+        for g, r in zip(got, ref):
+            assert_bitwise(g, r, "spec parked vs plain never-parked")
+        assert spec.park_hits == 1
+
+    def test_pool_pressure_on_resume_degrades_to_reprefill(self, tiny):
+        """Device pressure at resume time: the typed AdmissionError is
+        counted (host_degraded), the record is dropped, and the turn
+        re-prefills — bitwise correct, never wedged."""
+        parked = make_engine(tiny, host_blocks=64)
+        plain = make_engine(tiny)
+        rng = np.random.RandomState(27)
+        turns = [list(rng.randint(0, 256, 9))]
+        run_conversation(parked, turns, conv="g1")
+        run_conversation(plain, turns, conv=None)
+        hist_parked = parked._parked["g1"]["tokens"]
+        # Hog the device pool so the resume's reservation cannot fit:
+        # the request must DEGRADE (typed, counted) and stay queued —
+        # never wedge — then complete once the pressure clears.
+        hog_extent = parked.cache.free_blocks * parked.cache.block_size
+        parked.cache.reserve("hog", hog_extent)
+        from tony_tpu.serve import Request
+
+        turn2 = hist_parked + list(rng.randint(0, 256, 4))
+        parked.submit(Request(rid="g1t2", tokens=turn2,
+                              max_new_tokens=4, conv="g1"))
+        # step() directly: run(max_steps=) bounds the engine's LIFETIME
+        # step counter, which turn 1 already advanced past any small N.
+        for _ in range(3):
+            assert parked.step() == []
+        assert parked.host_degraded == 1, "the degrade is counted"
+        assert parked._parked == {}, "a failed resume drops the record"
+        parked.cache.free_seq("hog")
+        got = parked.run()
+        from tony_tpu.serve import EngineFront
+
+        ref = EngineFront(plain).generate(turn2, 4)
+        assert len(got) == 1
+        assert_bitwise(got[0], ref, "post-degrade re-prefill")
+
+    def test_host_tier_off_engine_never_parks(self, tiny):
+        eng = make_engine(tiny)
+        rng = np.random.RandomState(28)
+        run_conversation(eng, [list(rng.randint(0, 256, 9))], conv="x")
+        assert eng._parked == {} and eng.cache.parked_total == 0
+        assert eng.stats()["parked_seqs"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated composition: the decode replica parks, the returning
+# turn resumes through the colocated fallback path
+# ---------------------------------------------------------------------------
+
+class TestDisaggParking:
+    @pytest.mark.slow
+    def test_decode_side_park_resume_bitwise(self, tiny):
+        """Turn 1 rides the prefill→decode handoff (conv on the wire
+        payload); the decode engine parks it at eviction. Turn 2 lands
+        on the decode replica's colocated-fallback generate with the
+        same conv and RESUMES — bitwise vs a never-parked colocated
+        engine, with zero prefill launches for the parked extent."""
+        from tony_tpu.serve import EngineFront
+        from tony_tpu.serve.disagg import DecodeFront, PrefillFront
+
+        pf_eng = make_engine(tiny, role="prefill")
+        dc_eng = make_engine(tiny, role="decode", host_blocks=64)
+        plain = make_engine(tiny)
+        pf = PrefillFront(EngineFront(pf_eng))
+        dc = DecodeFront(EngineFront(dc_eng))
+        rng = np.random.RandomState(29)
+        t1 = list(rng.randint(0, 256, 9))
+        out1 = pf.prefill_handoff(t1, 4, rid="h1", decode=dc,
+                                  conv="dconv")
+        ref1 = EngineFront(plain).generate(t1, 4)
+        assert out1.tokens == ref1.tokens, "disagg turn 1 tokens"
+        assert dc_eng.parked_digest() == ["dconv"], \
+            "the decode engine holds the parked conversation"
+        # Turn 2: full history + new tokens through the decode
+        # replica's own front (the router's colocated fallback path).
+        hist = t1 + list(out1.tokens)
+        t2 = hist + list(rng.randint(0, 256, 6))
+        rows_before = dc_eng.prefill_rows
+        out2 = dc.generate(t2, 4, rid="h2", conv="dconv")
+        plain_rows_before = plain.prefill_rows
+        ref2 = EngineFront(plain).generate(t2, 4)
+        assert_bitwise(out2, ref2, "disagg turn 2 resume")
+        assert dc_eng.park_hits == 1
+        # Only the tail past the parked extent prefilled: strictly
+        # fewer padded rows than the never-parked full prefill.
+        assert dc_eng.prefill_rows - rows_before \
+            < plain.prefill_rows - plain_rows_before
+
+
+# ---------------------------------------------------------------------------
+# Persistent prefix store
+# ---------------------------------------------------------------------------
+
+class TestPrefixStore:
+    def _pool_with_stem(self, tokens):
+        from tony_tpu.serve import PagedKVCache, prefix
+
+        c = PagedKVCache(2, 8, n_blocks=8, block_size=4)
+        keys = prefix.chain_keys(tokens, 4)
+        c.admit_shared("a", len(tokens), keys)
+        for i, key in enumerate(keys):
+            c.write_index("a", i * 4)
+            c.publish_block("a", i, key)
+        return c, keys
+
+    def test_put_get_round_trip_idempotent(self, tmp_path):
+        from tony_tpu.serve import PrefixStore
+
+        c, keys = self._pool_with_stem(list(range(8)))
+        blocks = c.export_keys(keys)
+        store = PrefixStore(str(tmp_path / "stems"))
+        assert store.stems() == []
+        assert store.put(keys, blocks, c.wire_header()) is True
+        assert store.put(keys, blocks, c.wire_header()) is False, \
+            "a committed stem is idempotent"
+        assert store.stems() == [keys[-1]]
+        rec = store.get(keys[-1])
+        assert rec is not None
+        assert rec["keys"] == list(keys)
+        assert rec["header"] == c.wire_header()
+        # The wire-form payloads round-trip byte-exact (CRC included).
+        for got, want in zip(rec["blocks"], blocks):
+            assert got["crc"] == want["crc"]
+            assert got["k"] == want["k"] and got["v"] == want["v"]
+
+    def test_put_validates_and_get_rejects_corruption(self, tmp_path):
+        from tony_tpu.serve import PrefixStore
+
+        c, keys = self._pool_with_stem(list(range(8)))
+        blocks = c.export_keys(keys)
+        store = PrefixStore(str(tmp_path / "stems"))
+        with pytest.raises(ValueError):
+            store.put(keys[:1], blocks, c.wire_header())   # len mismatch
+        bad = [dict(b) for b in blocks]
+        bad[0]["crc"] ^= 1
+        with pytest.raises(ValueError):
+            store.put(keys, bad, c.wire_header())          # pre-write CRC
+        store.put(keys, blocks, c.wire_header())
+        # On-disk corruption: flip one byte of the chunk file — get()
+        # returns None (the replica recomputes), never bad bytes.
+        blob = tmp_path / "stems" / f"stem_{keys[-1]}" / "blocks.bin"
+        raw = bytearray(blob.read_bytes())
+        raw[3] ^= 1
+        blob.write_bytes(bytes(raw))
+        assert store.get(keys[-1]) is None
+
+    def test_tmp_staging_is_invisible(self, tmp_path):
+        from tony_tpu.serve import PrefixStore
+
+        root = tmp_path / "stems"
+        store = PrefixStore(str(root))
+        (root / "stem_deadbeef.tmp").mkdir(parents=True)
+        assert store.stems() == [], \
+            "a crashed staging dir must never be listed as committed"
+        assert store.get("deadbeef") is None
+
+    def test_engine_export_adopt_round_trip_bitwise(self, tiny,
+                                                    tmp_path):
+        """The full loop: a hot stem (proved shared by a second prompt)
+        exports to the store; a FRESH engine adopts it on start (the
+        replica `_load_stems` path, duck-typed) and serves the stem's
+        prompt with prefix hits — bitwise vs a cold engine."""
+        from tony_tpu.serve import EngineFront, PrefixStore
+        from tony_tpu.serve.replica import Replica
+
+        src = make_engine(tiny, prefix_cache=True)
+        rng = np.random.RandomState(31)
+        stem = list(rng.randint(0, 256, 16))
+        front = EngineFront(src)
+        front.generate(stem + list(rng.randint(0, 256, 3)), 3)
+        front.generate(stem + list(rng.randint(0, 256, 4)), 3)
+        store = PrefixStore(str(tmp_path / "stems"))
+        with front._drive:
+            wrote = src.export_stems(store)
+        assert wrote >= 1 and store.stems(), \
+            "a twice-proved stem must persist"
+        # A fresh replica adopts from the store on start.
+        fresh = make_engine(tiny, prefix_cache=True)
+        stub = Replica.__new__(Replica)
+        stub.engine = fresh
+        stub._store = store
+        Replica._load_stems(stub)
+        assert fresh.store_adopted > 0
+        check_partition(fresh.cache)
+        # The warmed engine serves the stem's NEXT prompt with prefix
+        # hits and stays bitwise vs a cold engine.
+        cold = make_engine(tiny, prefix_cache=True)
+        probe = stem + list(rng.randint(0, 256, 5))
+        got = EngineFront(fresh).generate(probe, 4)
+        ref = EngineFront(cold).generate(probe, 4)
+        assert_bitwise(got, ref, "store-warmed vs cold engine")
+        assert fresh.prefix_hit_blocks > 0, \
+            "the adopted stem must actually be hit"
+
+    def test_load_stems_skips_geometry_mismatch(self, tiny, tmp_path):
+        from tony_tpu.serve import PrefixStore
+        from tony_tpu.serve.replica import Replica
+
+        c, keys = self._pool_with_stem(list(range(8)))
+        store = PrefixStore(str(tmp_path / "stems"))
+        store.put(keys, c.export_keys(keys), c.wire_header())
+        eng = make_engine(tiny, prefix_cache=True)   # different geometry
+        stub = Replica.__new__(Replica)
+        stub.engine = eng
+        stub._store = store
+        Replica._load_stems(stub)
+        assert eng.store_adopted == 0, \
+            "a geometry-skewed stem must be skipped, not imported"
+        assert eng.cache.free_blocks == eng.cache.n_blocks
+
+    def test_adopt_stem_rejects_bad_input_quietly(self, tiny):
+        eng = make_engine(tiny, prefix_cache=True)
+        assert eng.adopt_stem([], []) == 0
+        assert eng.adopt_stem(["aa"], []) == 0        # length mismatch
+        off = make_engine(tiny)                       # prefix cache off
+        assert off.adopt_stem(["aa"], [{}]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Stats surface (the uniform fleet schema's host-tier half)
+# ---------------------------------------------------------------------------
+
+class TestTierStats:
+    def test_stats_count_tier_activity(self, tiny):
+        eng = make_engine(tiny, host_blocks=64)
+        rng = np.random.RandomState(32)
+        turns = [list(rng.randint(0, 256, 9)),
+                 list(rng.randint(0, 256, 4))]
+        run_conversation(eng, turns, conv="st")
+        s = eng.stats()
+        assert s["parked_seqs"] == 1.0
+        assert s["host_blocks"] >= 1.0
+        assert s["park_hit_rate"] == 0.5
+        assert set(eng.parked_digest()) == {"st"}
+
+    def test_write_stats_carries_parked_digest(self, tiny, tmp_path):
+        import json
+
+        eng = make_engine(tiny, host_blocks=64)
+        rng = np.random.RandomState(33)
+        run_conversation(eng, [list(rng.randint(0, 256, 9))], conv="wd")
+        path = tmp_path / "serve-stats.json"
+        eng.write_stats(str(path))
+        payload = json.loads(path.read_text())
+        assert payload["parked_digest"] == ["wd"]
+        assert payload["parked_seqs"] == 1.0

@@ -493,7 +493,14 @@ class TestStatsSchema:
         assert stats["prefix_cache_hit_rate"] == 0.0
         assert stats["blocks_shared"] == 0.0
         assert stats["prefill_chunks"] == 0.0
+        # KV-tier fields ship as zeros on engines without the host tier:
+        # the fleet schema stays uniform so the router and autoscaler
+        # never branch on schema presence.
+        for key in ("host_blocks", "parked_seqs", "demotions",
+                    "promotions", "park_hit_rate"):
+            assert stats[key] == 0.0
         assert eng.prefix_digest() == []
+        assert eng.parked_digest() == []
 
     def test_spec_engine_publishes_schema_zeros(self, tiny):
         from tony_tpu.serve import Request, SpecEngine
@@ -506,7 +513,8 @@ class TestStatsSchema:
         eng.run()
         stats = eng.stats()
         for key in ("prefix_cache_hit_rate", "blocks_shared",
-                    "prefill_chunks"):
+                    "prefill_chunks", "host_blocks", "parked_seqs",
+                    "demotions", "promotions", "park_hit_rate"):
             assert stats[key] == 0.0
 
     def test_stats_file_carries_digest_and_rpc_port(self, tiny, tmp_path):
@@ -547,7 +555,11 @@ class TestStatsSchema:
         payload = {"qps": 1.0, "p99_ms": 12.0, "queue_depth": 2.0,
                    "prefix_cache_hit_rate": 0.75, "blocks_shared": 6.0,
                    "prefill_chunks": 3.0, "rpc_port": 5555,
-                   "prefix_digest": ["aa", "bb"]}
+                   "host_blocks": 4.0, "parked_seqs": 2.0,
+                   "demotions": 5.0, "promotions": 3.0,
+                   "park_hit_rate": 0.5,
+                   "prefix_digest": ["aa", "bb"],
+                   "parked_digest": ["conv-1", "conv-2"]}
         try:
             executor = TaskExecutor(env={
                 constants.ENV_JOB_NAME: "serve",
@@ -570,7 +582,13 @@ class TestStatsSchema:
             assert got["prefix_cache_hit_rate"] == 0.75
             assert got["blocks_shared"] == 6.0
             assert got["prefill_chunks"] == 3.0
+            assert got["host_blocks"] == 4.0
+            assert got["parked_seqs"] == 2.0
+            assert got["demotions"] == 5.0
+            assert got["promotions"] == 3.0
+            assert got["park_hit_rate"] == 0.5
             assert got["prefix_digest"] == ["aa", "bb"]
+            assert got["parked_digest"] == ["conv-1", "conv-2"]
             assert got["rpc_port"] == 5555.0
             # serve_endpoints exposes the routable wire form...
             eps = session.serve_endpoints("serve")
@@ -581,6 +599,7 @@ class TestStatsSchema:
             views = router.replicas()
             assert views[0].address == "127.0.0.1:5555"
             assert views[0].digest == frozenset(["aa", "bb"])
+            assert views[0].parked == frozenset(["conv-1", "conv-2"])
         finally:
             server.stop()
 
@@ -591,7 +610,10 @@ class TestStatsSchema:
                                     queue_high=8.0, queue_low=1.0)
         hot = [{"queue_depth": 12.0, "p99_ms": 100.0,
                 "prefix_cache_hit_rate": 0.9, "blocks_shared": 50.0,
-                "prefill_chunks": 7.0, "prefix_digest": ["aa"]}]
+                "prefill_chunks": 7.0, "prefix_digest": ["aa"],
+                "host_blocks": 4.0, "parked_seqs": 2.0,
+                "demotions": 5.0, "promotions": 3.0,
+                "park_hit_rate": 0.5, "parked_digest": ["conv-1"]}]
         assert scaling.decide(pol, 1, hot, now=0.0) == 1
 
 
@@ -636,7 +658,8 @@ class TestRouter:
             def __init__(self, name):
                 self.name = name
 
-            def generate(self, tokens, max_new_tokens, rid=None):
+            def generate(self, tokens, max_new_tokens, rid=None,
+                         conv=None):
                 calls[self.name] += 1
                 return {"rid": rid, "tokens": [0], "latency_ms": 1.0}
 
@@ -656,6 +679,48 @@ class TestRouter:
         assert moved["replica"] == "b", "retirement must re-dispatch"
         assert calls == {"a": 2, "b": 1}
 
+    def test_parked_digest_repins_returning_conversation(self):
+        """A returning turn with no affinity pin (router restart) lands
+        on the replica holding its PARKED KV — the host-tier resume
+        beats any overlap score — and the pin re-establishes."""
+        from tony_tpu.serve.router import RequestRouter
+
+        seen = []
+
+        class Client:
+            def __init__(self, name):
+                self.name = name
+
+            def generate(self, tokens, max_new_tokens, rid=None,
+                         conv=None):
+                seen.append((self.name, conv))
+                return {"rid": rid, "tokens": [0], "latency_ms": 1.0}
+
+        rt = RequestRouter(block_size=16)
+        # "cold" scores better on load; "warm" holds the parked conv.
+        rt.upsert_replica("cold", client=Client("cold"),
+                          stats={"queue_depth": 0.0})
+        rt.upsert_replica("warm", client=Client("warm"),
+                          stats={"queue_depth": 5.0,
+                                 "parked_digest": ["turnful"]})
+        out = rt.dispatch(list(range(16)), 2, session_id="turnful")
+        assert out["replica"] == "warm"
+        assert rt.stats()["park_pins"] == 1.0
+        # conv rides the dispatch so the engine can resume under it.
+        assert seen == [("warm", "turnful")]
+        # The re-pin is sticky: the next turn is an affinity hit, not
+        # another parked-digest scan.
+        rt.dispatch(list(range(16)), 2, session_id="turnful")
+        assert rt.affinity_hits == 1 and rt.stats()["park_pins"] == 1.0
+        # Sessionless dispatch ships NO conv kwarg (stub back-compat).
+        class Legacy:
+            def generate(self, tokens, max_new_tokens, rid=None):
+                return {"rid": rid, "tokens": [1], "latency_ms": 1.0}
+
+        rt.upsert_replica("cold", client=Legacy(),
+                          stats={"queue_depth": 0.0})
+        assert rt.dispatch([1, 2], 2)["tokens"] == [1]
+
     def test_dead_replica_fails_over_and_revives_on_heartbeat(self):
         from tony_tpu.serve.router import RequestRouter
 
@@ -664,7 +729,8 @@ class TestRouter:
                 raise ConnectionError("gone")
 
         class Live:
-            def generate(self, tokens, max_new_tokens, rid=None):
+            def generate(self, tokens, max_new_tokens, rid=None,
+                         conv=None):
                 return {"rid": rid, "tokens": [0], "latency_ms": 1.0}
 
         rt = RequestRouter(block_size=16)
@@ -793,7 +859,8 @@ class TestRoutedServing:
             def __init__(self, front):
                 self.front = front
 
-            def rpc_generate(self, tokens, max_new_tokens=16, rid=None):
+            def rpc_generate(self, tokens, max_new_tokens=16, rid=None,
+                             conv=None):
                 c = self.front.generate(tokens, max_new_tokens, rid=rid)
                 return {"rid": c.rid, "tokens": c.tokens,
                         "latency_ms": round(1e3 * c.latency_s, 3)}
@@ -841,8 +908,12 @@ class TestRoutedServing:
         assert args.cache_weight == 4.0
         sv = make_parser().parse_args([
             "serve", "--model", "llama-tiny", "--ckpt_dir",
-            str(tmp_path), "--prefix_cache", "--prefill_chunk", "32"])
+            str(tmp_path), "--prefix_cache", "--prefill_chunk", "32",
+            "--host_blocks", "64", "--prefix_store",
+            str(tmp_path / "stems")])
         assert sv.prefix_cache and sv.prefill_chunk == 32
+        assert sv.host_blocks == 64
+        assert sv.prefix_store == str(tmp_path / "stems")
         from tony_tpu.cli import cmd_serve
 
         bad = make_parser().parse_args([
@@ -850,6 +921,11 @@ class TestRoutedServing:
             str(tmp_path), "--prefill_chunk", "12"])
         with pytest.raises(SystemExit, match="prefill_chunk"):
             cmd_serve(bad)
+        bad_tier = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--ckpt_dir",
+            str(tmp_path), "--host_blocks", "-1"])
+        with pytest.raises(SystemExit, match="host_blocks"):
+            cmd_serve(bad_tier)
 
 
 # ---------------------------------------------------------------------------

@@ -342,11 +342,18 @@ class ApplicationMaster:
                                    session.serve_samples(jt), now=now,
                                    last_action=self._serve_scale_last[jt])
             if delta > 0:
+                # The grant names the prefix store (when conf declares
+                # one): the fresh replica warms its prefix tier from
+                # disk instead of recomputing hot stems, so a scale-up
+                # replica is useful from its first request.
+                store = self.conf.get(
+                    conf_mod.SERVE_PREFIX_STORE, "") or ""
+                store_note = f", prefix store {store}" if store else ""
                 for _ in range(delta):
                     task = session.add_task(jt)
                     self._log(f"serve scale-up -> launching elastic "
                               f"replica {task.task_id} "
-                              f"({len(live) + 1} live)")
+                              f"({len(live) + 1} live{store_note})")
                     self._try_launch(session, jt, task.index)
                 self._serve_scale_last[jt] = now
             elif delta < 0:

@@ -162,6 +162,18 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                or args.prefill_chunk % 16):
         raise SystemExit(f"--prefill_chunk must be a positive multiple "
                          f"of the 16-row block, got {args.prefill_chunk}")
+    # KV memory hierarchy (tony_tpu.serve PR 16): host tier size and the
+    # persistent prefix store. Validate at submit — a negative tier or a
+    # relative store path (replicas run with a different cwd) would fail
+    # replica by replica at launch.
+    if args.host_blocks < 0:
+        raise SystemExit(f"--host_blocks must be >= 0, got "
+                         f"{args.host_blocks}")
+    if args.host_blocks:
+        cfg.set(conf_mod.SERVE_HOST_BLOCKS, str(args.host_blocks))
+    if args.prefix_store:
+        cfg.set(conf_mod.SERVE_PREFIX_STORE,
+                str(Path(args.prefix_store).resolve()))
     if args.prefix_cache:
         cfg.set(conf_mod.SERVE_PREFIX_CACHE, "true")
     if args.prefill_chunk:
@@ -441,6 +453,18 @@ def make_parser() -> argparse.ArgumentParser:
                          "gangs in one job) and the router ships KV "
                          "blocks prefill->decode over the RPC wire; "
                          "omit for the classic colocated fleet")
+    sv.add_argument("--host_blocks", type=int, default=0,
+                    help="pinned host-RAM KV tier size in blocks (0 = "
+                         "off): cold published stems demote to host "
+                         "instead of dying at LRU eviction, and idle "
+                         "conversations park between turns — resumed "
+                         "turns skip their re-prefill bitwise")
+    sv.add_argument("--prefix_store", default=None, metavar="DIR",
+                    help="persistent prefix store directory: hot "
+                         "published stems commit to disk through the "
+                         "ckpt plane's atomic rename, and fresh or "
+                         "scale-up replicas warm their prefix tier "
+                         "from the store on start")
     sv.add_argument("--spec_k", type=int, default=0,
                     help="speculative decoding draft depth (0 = off; "
                          "k tokens drafted, verified in ONE target "

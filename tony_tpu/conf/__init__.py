@@ -147,6 +147,14 @@ SERVE_DRAFT_NGRAM_MAX = "tony.serve.draft.ngram-max"  # fallback n-gram n
 # engine config. The AM's autoscaler and the serve_endpoints verb treat
 # every role-keyed jobtype (plus the classic "serve") as serving.
 SERVE_ROLE_PREFIX = "tony.serve.role."
+# KV memory hierarchy (PR 16): host-blocks > 0 arms the pool's host-
+# offload tier (cold published stems demote to host RAM, finished
+# conversation turns PARK there and resume without re-prefill); the
+# prefix store names an on-disk directory of persisted hot stems —
+# replicas load it at startup and scale-up grants inherit it, so a
+# fresh replica warms its prefix tier from disk instead of recompute.
+SERVE_HOST_BLOCKS = "tony.serve.host-blocks"    # host tier size (0 = off)
+SERVE_PREFIX_STORE = "tony.serve.prefix-store"  # stem store dir ("" = off)
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

@@ -45,7 +45,15 @@ the execution loop watches every user rebuild it badly):
   wire — per-block CRC, shared-prefix stems adopted instead of
   re-transferred, bounded retry with a typed :class:`~tony_tpu.serve.
   disagg.HandoffError`, and the decode replica's loop issuing zero
-  prefill launches while the prefill gang absorbs bursts.
+  prefill launches while the prefill gang absorbs bursts;
+* :mod:`~tony_tpu.serve.kvstore` — the persistent prefix store
+  (jax-free): hot published stems on disk through the ckpt plane's
+  stage-and-rename commit, keyed by chain hash, so a fresh replica or
+  scale-up grant warms its prefix tier from the store instead of
+  recompute. Together with the pool's host-offload tier and
+  conversation parking (:mod:`~tony_tpu.serve.kvcache` /
+  :mod:`~tony_tpu.serve.engine`) this completes the KV memory
+  hierarchy: device pool → pinned host RAM → disk.
 
 Numerics contract: continuous-batching decode is BIT-identical to a
 sequential full prefill of the same tokens — every op in the serve
@@ -59,10 +67,11 @@ from typing import Any
 
 __all__ = ["AdmissionError", "Completion", "DecodeFront", "EngineFront",
            "HandoffError", "KVShipper", "ModelDraft", "NgramDraft",
-           "NoReplicaError", "PagedKVCache", "PrefillFront", "Request",
-           "RequestRouter", "RouterPolicy", "RouterServer", "ServeEngine",
-           "SpecEngine", "disagg", "engine", "kvcache", "prefix",
-           "replica", "router", "scaling", "spec"]
+           "NoReplicaError", "PagedKVCache", "PrefillFront",
+           "PrefixStore", "Request", "RequestRouter", "RouterPolicy",
+           "RouterServer", "ServeEngine", "SpecEngine", "disagg",
+           "engine", "kvcache", "kvstore", "prefix", "replica",
+           "router", "scaling", "spec"]
 
 # LAZY facade (PEP 562, like tony_tpu.analysis): the engine pulls jax,
 # but the AM's autoscaler only needs the pure scaling policy and the
@@ -79,9 +88,10 @@ _LAZY = {
     "RouterPolicy": "router", "RouterServer": "router",
     "HandoffError": "disagg", "KVShipper": "disagg",
     "PrefillFront": "disagg", "DecodeFront": "disagg",
+    "PrefixStore": "kvstore",
     "disagg": None,
-    "engine": None, "kvcache": None, "prefix": None, "replica": None,
-    "router": None, "scaling": None, "spec": None,
+    "engine": None, "kvcache": None, "kvstore": None, "prefix": None,
+    "replica": None, "router": None, "scaling": None, "spec": None,
 }
 
 

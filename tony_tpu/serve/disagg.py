@@ -208,10 +208,12 @@ class DecodeFront:
         return self.front._drive_until(rid)
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
-                 rid: Optional[Any] = None) -> Any:
+                 rid: Optional[Any] = None,
+                 conv: Optional[Any] = None) -> Any:
         """The colocated fallback path (the decode engine prefills for
         itself when a handoff could not be placed)."""
-        return self.front.generate(tokens, max_new_tokens, rid=rid)
+        return self.front.generate(tokens, max_new_tokens, rid=rid,
+                                   conv=conv)
 
 
 class PrefillFront:
@@ -231,7 +233,8 @@ class PrefillFront:
 
     def prefill_handoff(self, tokens: Sequence[int], max_new_tokens: int,
                         rid: Optional[Any] = None,
-                        decode: Any = None) -> Any:
+                        decode: Any = None,
+                        conv: Optional[Any] = None) -> Any:
         if decode is None:
             raise ValueError("prefill_handoff needs a decode target "
                              "(a DecodeFront or a host:port address)")
@@ -248,7 +251,7 @@ class PrefillFront:
             try:
                 handoff = eng.prefill_only(Request(
                     rid=rid, tokens=[int(t) for t in tokens],
-                    max_new_tokens=int(max_new_tokens)))
+                    max_new_tokens=int(max_new_tokens), conv=conv))
             except AdmissionError as e:
                 if not getattr(e, "retryable", True):
                     raise               # never fits: same as colocated submit
@@ -276,8 +279,10 @@ class PrefillFront:
         return out
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
-                 rid: Optional[Any] = None) -> Any:
-        return self.front.generate(tokens, max_new_tokens, rid=rid)
+                 rid: Optional[Any] = None,
+                 conv: Optional[Any] = None) -> Any:
+        return self.front.generate(tokens, max_new_tokens, rid=rid,
+                                   conv=conv)
 
 
 def _dial_decode(address: str, timeout: float) -> Any:
@@ -295,10 +300,10 @@ def _dial_decode(address: str, timeout: float) -> Any:
             with RpcClient(address, timeout=timeout) as client:
                 return client.call("kv_import", payload=payload)
 
-        def generate(self, tokens, max_new_tokens, rid=None):
+        def generate(self, tokens, max_new_tokens, rid=None, conv=None):
             with RpcClient(address, timeout=timeout) as client:
                 return client.call("generate", tokens=list(tokens),
                                    max_new_tokens=int(max_new_tokens),
-                                   rid=rid)
+                                   rid=rid, conv=conv)
 
     return _Decode()

@@ -63,7 +63,7 @@ import os
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -87,6 +87,12 @@ class Request:
     rid: Any
     tokens: List[int]
     max_new_tokens: int
+    # Conversation handle (opaque; the router passes its session id).
+    # Non-None arms parking on an engine with the host tier: eviction
+    # parks the sequence's KV under this handle instead of dropping it,
+    # and the NEXT request carrying the same handle resumes from the
+    # parked blocks instead of re-prefilling the shared history.
+    conv: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -111,10 +117,11 @@ class Completion:
 
 class _Seq:
     __slots__ = ("rid", "tokens", "n_prompt", "remaining", "logits",
-                 "t_submit", "pf_pos", "published", "hkey")
+                 "t_submit", "pf_pos", "published", "hkey", "conv")
 
     def __init__(self, req: Request, t_submit: float):
         self.rid = req.rid
+        self.conv = req.conv
         self.tokens: List[int] = list(req.tokens)
         self.n_prompt = len(req.tokens)
         self.remaining = int(req.max_new_tokens)
@@ -189,7 +196,9 @@ class PagedModelRunner:
     def _init_paged(self, model: Any, params: Any, *, ctx_max: int,
                     block_size: int, q_block: int,
                     decode_buckets: Sequence[int], max_running: int,
-                    n_blocks: Optional[int], mesh: Optional[Any]) -> None:
+                    n_blocks: Optional[int], mesh: Optional[Any],
+                    host_blocks: int = 0,
+                    async_offload: bool = False) -> None:
         cfg = model.cfg
         if q_block % 8:
             raise ValueError(f"q_block must be a sublane-tile multiple "
@@ -212,7 +221,9 @@ class PagedModelRunner:
         self.cache = PagedKVCache(self.n_layers, self.kv_dim,
                                   n_blocks=n_blocks,
                                   block_size=self.block_size,
-                                  dtype=cfg.dtype)
+                                  dtype=cfg.dtype,
+                                  host_blocks=host_blocks,
+                                  async_offload=async_offload)
         self._fns: Dict[Tuple[int, int], Callable] = {}
         # Forward-launch counter (prefills + decode/verify steps): the
         # machine-independent cost of a schedule — on an accelerator the
@@ -267,7 +278,8 @@ class ServeEngine(PagedModelRunner):
                  stats_window_s: float = 60.0, tag: str = "serve",
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 role: str = "colocated"):
+                 role: str = "colocated", host_blocks: int = 0,
+                 async_offload: bool = False):
         if join_policy not in ("continuous", "static"):
             raise ValueError(f"unknown join_policy {join_policy!r} "
                              "(continuous|static)")
@@ -278,7 +290,8 @@ class ServeEngine(PagedModelRunner):
                          block_size=block_size, q_block=q_block,
                          decode_buckets=decode_buckets,
                          max_running=max_running, n_blocks=n_blocks,
-                         mesh=mesh)
+                         mesh=mesh, host_blocks=host_blocks,
+                         async_offload=async_offload)
         # Prefix caching (off by default — the unrouted PR 10/12
         # behavior): admission chain-hashes the prompt's full blocks and
         # adopts published matches instead of recomputing them. Bitwise
@@ -309,6 +322,28 @@ class ServeEngine(PagedModelRunner):
         self.imports_failed = 0
         self.handoffs_out = 0
         self.handoffs_in = 0
+        # KV memory hierarchy (PR 16): with a host tier armed
+        # (host_blocks > 0), eviction PARKS a conversation-tagged
+        # sequence instead of dropping its KV, and the conversation's
+        # next turn resumes from the parked blocks through the atomic
+        # import path — no re-prefill of the shared history. The map is
+        # conversation handle -> {"tokens": full parked token history,
+        # "rid": the parked cache record's id}.
+        self.host_offload = host_blocks > 0
+        self._parked: Dict[Any, Dict[str, Any]] = {}
+        self.park_hits = 0
+        self.park_lookups = 0
+        # Typed degrades: promotion/resume failures that fell back to
+        # re-prefill (pool pressure or a corrupt host payload) — the
+        # hierarchy may cost recompute, never a wedge or a wrong byte.
+        self.host_degraded = 0
+        # Persistent prefix store bookkeeping: chain-parent links (to
+        # walk a hot tip back to its root when exporting a stem) and
+        # the most-recently-adopted tips (the export candidates).
+        self._chain_parent: "OrderedDict[str, str]" = OrderedDict()
+        self._hot_tips: "OrderedDict[str, None]" = OrderedDict()
+        self._stored_tips: set = set()
+        self.store_adopted = 0
         self.keep_logits = keep_logits
         self.join_policy = join_policy
         self.tag = tag
@@ -477,8 +512,33 @@ class ServeEngine(PagedModelRunner):
             key = prefix_mod.chain_keys(
                 seq.tokens[i * bs:(i + 1) * bs], bs, prior=seq.hkey)[0]
             self.cache.publish_block(seq.rid, i, key)
+            self._note_parent(key, seq.hkey)
             seq.hkey = key
             seq.published += 1
+
+    def _note_parent(self, key: str, prior: str) -> None:
+        """Record one chain link (bounded) so a hot tip can be walked
+        back to its root when the persistent store exports the stem."""
+        self._chain_parent[key] = prior
+        self._chain_parent.move_to_end(key)
+        while len(self._chain_parent) > 4096:
+            self._chain_parent.popitem(last=False)
+
+    def _note_parents(self, keys: Sequence[str]) -> None:
+        for i, key in enumerate(keys):
+            self._note_parent(key, keys[i - 1] if i else "")
+
+    def _note_chain(self, keys: Sequence[str], matched: int) -> None:
+        """An adoption PROVED blocks shared — remember the links and
+        mark the adopted tip hot (the persistent prefix store exports
+        the hottest few tips, i.e. exactly the stems a second
+        conversation reused)."""
+        self._note_parents(keys[:matched])
+        tip = keys[matched - 1]
+        self._hot_tips[tip] = None
+        self._hot_tips.move_to_end(tip)
+        while len(self._hot_tips) > 64:
+            self._hot_tips.popitem(last=False)
 
     # -- decode ------------------------------------------------------------
     def _decode(self) -> None:
@@ -526,10 +586,25 @@ class ServeEngine(PagedModelRunner):
         replica that decodes)."""
         if total is None:
             total = len(req.tokens) + req.max_new_tokens
+        if self.host_offload and req.conv is not None:
+            res = self._try_resume(req, total)
+            if res is not None:
+                return res
         if not self.prefix_cache:
             self.cache.reserve(req.rid, total)
             return 0, 0, ()
         keys = prefix_mod.chain_keys(req.tokens, self.block_size)
+        if self.host_offload:
+            # Re-stage any demoted stretch of this prompt's chain from
+            # the host tier before matching — the admission then adopts
+            # it like any published stem. A corrupt host payload
+            # degrades to recompute (the poison entry dropped so it
+            # cannot fail every later admission), never an error.
+            try:
+                self.cache.promote(keys)
+            except HandoffError:
+                self.cache.discard_host(keys)
+                self.host_degraded += 1
         matched = self.cache.admit_shared(req.rid, total, keys)
         m = matched * self.block_size
         if m >= len(req.tokens):
@@ -556,7 +631,91 @@ class ServeEngine(PagedModelRunner):
         # prefix_cache_hit_rate with every retry.
         self.prefix_lookup_blocks += len(keys)
         self.prefix_hit_blocks += matched
+        if matched:
+            self._note_chain(keys, matched)
         return min(m, len(req.tokens) - 1), matched, keys
+
+    def _park_keys(self, tokens: Sequence[int], length: int
+                   ) -> List[str]:
+        """Chain keys of the FULL blocks inside ``tokens[:length]`` —
+        the parked record's resume-time adoption probe (one key per
+        full block; a partial tail block ships keyless, exactly the
+        wire contract)."""
+        bs = self.block_size
+        return prefix_mod.chain_keys(
+            list(tokens)[:(int(length) // bs) * bs], bs)
+
+    def _park(self, seq: _Seq) -> bool:
+        """Park ``seq``'s KV under its conversation handle instead of
+        freeing it. The parked extent is ``len(tokens) - 1`` — every
+        row strictly below the newest token is verified-written (the
+        final emitted token's row is never computed), the same bound
+        :meth:`_publish` trusts. A re-park of the same conversation
+        drops the stale turn first; a full host tier returns False
+        (state unchanged) and eviction degrades to the plain free."""
+        length = len(seq.tokens) - 1
+        if length <= 0:
+            return False
+        old = self._parked.pop(seq.conv, None)
+        if old is not None:
+            self.cache.unpark(old["rid"])
+        try:
+            self.cache.park(seq.rid, length,
+                            keys=self._park_keys(seq.tokens, length))
+        except AdmissionError:
+            return False
+        self._parked[seq.conv] = {"tokens": list(seq.tokens),
+                                  "rid": seq.rid}
+        return True
+
+    def _try_resume(self, req: Request, total: int
+                    ) -> Optional[Tuple[int, int, Sequence[str]]]:
+        """Resume ``req`` from its conversation's parked KV: adopt what
+        is still on device, re-stage the rest from the host payloads,
+        and start the prefill cursor at the parked extent — the shared
+        history's launches are simply never issued. Bitwise transparent
+        by the chunked-prefill split-point contract: rows from the
+        cursor on compute exactly what a full prefill would compute
+        there. ``None`` (nothing changed beyond dropping a dead record)
+        falls through to fresh admission: no parked record, a diverged
+        prompt, or a typed resume failure (pool pressure / host
+        corruption — counted in ``host_degraded``; the conversation
+        pays a re-prefill, never a wedge)."""
+        self.park_lookups += 1
+        rec = self._parked.get(req.conv)
+        if rec is None:
+            return None
+        ptoks = rec["tokens"]
+        length = len(ptoks) - 1
+        if len(req.tokens) < len(ptoks) \
+                or list(req.tokens)[:len(ptoks)] != ptoks:
+            # The turn does not extend the parked history (edited or
+            # truncated conversation): the record can never be resumed
+            # by a later turn either — drop it.
+            self._parked.pop(req.conv, None)
+            self.cache.unpark(rec["rid"])
+            return None
+        try:
+            self.cache.resume(req.rid, total, rec["rid"])
+        except (AdmissionError, HandoffError):
+            self._parked.pop(req.conv, None)
+            self.cache.unpark(rec["rid"])
+            self.host_degraded += 1
+            return None
+        self._parked.pop(req.conv, None)
+        self.park_hits += 1
+        keys = self._park_keys(ptoks, length)
+        if self.prefix_cache and keys:
+            # The resumed blocks hold verified rows — index them so
+            # other prompts adopt the shared history, and seed the
+            # publication cursor past them (the admit_handoff idiom).
+            for i, key in enumerate(keys):
+                self.cache.publish_block(req.rid, i, key)
+            self._note_parents(keys)
+            self.prefix_lookup_blocks += len(keys)
+            self.prefix_hit_blocks += len(keys)
+            return length, len(keys), keys
+        return length, 0, ()
 
     def _seed_publication(self, seq: _Seq, matched: int,
                           keys: Sequence[str]) -> None:
@@ -600,7 +759,14 @@ class ServeEngine(PagedModelRunner):
                 self._running.append(seq)
 
     def _evict(self, seq: _Seq, results: List[Completion]) -> None:
-        self.cache.free_seq(seq.rid)
+        # Conversation parking: a host-tier engine keeps a finished
+        # conversation-tagged turn's KV (demoted to host RAM) instead
+        # of dropping it — the next turn resumes where this one ended.
+        # cache.park frees the device reservation itself; a full host
+        # tier degrades to the plain free below.
+        if not (self.host_offload and seq.conv is not None
+                and self._park(seq)):
+            self.cache.free_seq(seq.rid)
         now = time.monotonic()
         # Under the lock: the stats publisher thread (replica heartbeat)
         # iterates this ring concurrently with the drive thread, and a
@@ -682,6 +848,7 @@ class ServeEngine(PagedModelRunner):
             "first_token": first,
             "max_new_tokens": int(req.max_new_tokens),
             "length": n,
+            "conv": req.conv,
             "keys": wire_keys,
             "blocks": self.cache.export_blocks(req.rid, n),
             **self.cache.wire_header(),
@@ -835,7 +1002,8 @@ class ServeEngine(PagedModelRunner):
             self.imports_failed += 1
             raise
         seq = _Seq(Request(rid=rid, tokens=tokens,
-                           max_new_tokens=max_new), time.monotonic())
+                           max_new_tokens=max_new,
+                           conv=payload.get("conv")), time.monotonic())
         seq.pf_pos = n                     # the prompt arrived computed
         seq.tokens.append(first)
         seq.remaining -= 1                 # the prefill side emitted it
@@ -849,6 +1017,7 @@ class ServeEngine(PagedModelRunner):
             # what it computes.
             for i, key in enumerate(keys):
                 self.cache.publish_block(rid, i, key)
+            self._note_parents(keys)
             seq.published = len(keys)
             seq.hkey = keys[-1]
         self.handoffs_in += 1
@@ -868,6 +1037,68 @@ class ServeEngine(PagedModelRunner):
         with self._lock:
             self.blocks_shipped += int(blocks)
             self.handoffs_out += 1
+
+    # -- persistent prefix store (tony_tpu.serve.kvstore) ------------------
+    def adopt_stem(self, keys: Sequence[str],
+                   blocks: Sequence[Dict[str, Any]]) -> int:
+        """Seed the prefix tier from a persisted stem (replica startup,
+        or a scale-up grant naming the store): import the chain's
+        payloads through the SAME verify-then-commit path a handoff
+        rides, publish them, and release the scratch reservation so the
+        blocks land in the refcount-0 cached tier — exactly where a
+        local conversation's published stem would sit. Best-effort by
+        design: a corrupt chunk or pool pressure returns 0 adopted
+        blocks (the replica warms from recompute instead), never an
+        error. Returns blocks newly indexed."""
+        keys = [str(k) for k in keys]
+        if not self.prefix_cache or not keys \
+                or len(keys) != len(blocks):
+            return 0
+        matched = len(self.cache.match_prefix(keys))
+        if matched >= len(keys):
+            return 0
+        sid = ("stem", keys[-1])
+        try:
+            self.cache.import_blocks(
+                sid, len(keys) * self.block_size,
+                list(blocks)[matched:], keys=keys, offset=matched)
+        except (AdmissionError, HandoffError):
+            return 0
+        for i, key in enumerate(keys):
+            self.cache.publish_block(sid, i, key)
+        self.cache.free_seq(sid)
+        self._note_parents(keys)
+        self.store_adopted += len(keys) - matched
+        return len(keys) - matched
+
+    def export_stems(self, store: Any, limit: int = 8) -> int:
+        """Persist the hottest adopted stems (chains a SECOND prompt
+        proved shared) into ``store`` (:class:`tony_tpu.serve.kvstore.
+        PrefixStore`) — idempotent per tip, skipping chains whose
+        blocks aged out of the device index. The caller owns the drive
+        lock (the export reads the pool). Returns stems written."""
+        wrote = 0
+        for tip in list(self._hot_tips)[-limit:]:
+            if tip in self._stored_tips:
+                continue
+            chain: List[str] = []
+            key = tip
+            while key:
+                chain.append(key)
+                key = self._chain_parent.get(key)
+                if key is None or len(chain) > self.cache.n_blocks:
+                    chain = []
+                    break
+            if not chain:
+                continue
+            chain.reverse()
+            if len(self.cache.match_prefix(chain)) < len(chain):
+                continue                 # partly aged out: not exportable
+            store.put(chain, self.cache.export_keys(chain),
+                      self.cache.wire_header())
+            self._stored_tips.add(tip)
+            wrote += 1
+        return wrote
 
     def step(self) -> List[Completion]:
         """One engine iteration: join what fits, advance one prefill
@@ -999,6 +1230,17 @@ class ServeEngine(PagedModelRunner):
             "blocks_shipped": float(self.blocks_shipped),
             "handoff_ms": float(self.handoff_ms),
             "imports_failed": float(self.imports_failed),
+            # KV memory hierarchy telemetry (PR 16): zeros on engines
+            # without the host tier, so the fleet schema stays uniform
+            # (same rule as every widening above). park_hit_rate is the
+            # fraction of conversation-tagged admissions that resumed
+            # from parked KV instead of re-prefilling.
+            "host_blocks": float(self.cache.host_blocks_used),
+            "parked_seqs": float(len(self._parked)),
+            "demotions": float(self.cache.demoted_total),
+            "promotions": float(self.cache.promoted_total),
+            "park_hit_rate": (self.park_hits / self.park_lookups
+                              if self.park_lookups else 0.0),
         }
         stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
@@ -1018,6 +1260,14 @@ class ServeEngine(PagedModelRunner):
             return []
         return self.cache.digest(limit)
 
+    def parked_digest(self, limit: int = 256) -> List[str]:
+        """The replica's parked-conversation advertisement: the
+        conversation handles whose KV this engine holds in its host
+        tier. Rides the heartbeat next to the prefix digest so the
+        router re-pins a returning turn to the replica that can resume
+        it without a re-prefill; empty without the tier."""
+        return [str(c) for c in list(self._parked)[-limit:]]
+
     def write_stats(self, path: str,
                     extra: Optional[Dict[str, Any]] = None) -> None:
         """Atomically publish :meth:`stats` as JSON — the file the
@@ -1030,6 +1280,9 @@ class ServeEngine(PagedModelRunner):
         digest = self.prefix_digest()
         if digest:
             payload["prefix_digest"] = digest
+        parked = self.parked_digest()
+        if parked:
+            payload["parked_digest"] = parked
         if extra:
             payload.update(extra)
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -1099,13 +1352,16 @@ class EngineFront:
             return f"req-{self._rid_ns}-{self._rid}"
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
-                 rid: Optional[Any] = None) -> Completion:
+                 rid: Optional[Any] = None,
+                 conv: Optional[Any] = None) -> Completion:
         """Submit one request and drive the shared engine until it
-        completes."""
+        completes. ``conv`` tags the request with its conversation
+        handle so a host-tier engine parks/resumes it across turns."""
         if rid is None:
             rid = self.fresh_rid()
         self.engine.submit(Request(rid=rid, tokens=list(tokens),
-                                   max_new_tokens=int(max_new_tokens)))
+                                   max_new_tokens=int(max_new_tokens),
+                                   conv=conv))
         return self._drive_until(rid)
 
     def _drive_until(self, rid: Any) -> Completion:

@@ -48,6 +48,28 @@ atomic like :meth:`~PagedKVCache.admit_shared` and composing with the
 prefix tier, so a shipped shared-prefix stem that the importer already
 holds is adopted instead of re-written.
 
+The host-offload tier (PR 16) turns the pool into a THREE-tier memory
+hierarchy: ``host_blocks > 0`` arms a host-RAM tier holding the same
+CRC-guarded wire payloads the handoff ships, so everything that moves
+between device and host re-enters through the import path's
+verify-then-commit discipline — a corrupt host byte can never reach
+the pool. Two populations live there:
+
+* **demoted stems** — when the LIFO tier runs dry, the cached tier's
+  LRU eviction spills the victim's content to host instead of dropping
+  it (the existing ref-aware LRU order IS the demotion policy);
+  :meth:`~PagedKVCache.promote` re-stages a chain-key run back into
+  device blocks (one batched scatter) where the device index stops
+  matching, placing them refcount-0 in the cached tier so the
+  admission that follows adopts them like any published block;
+* **parked sequences** — :meth:`~PagedKVCache.park` snapshots a
+  sequence's blocks (ONE batched device fetch per pool) plus its chain
+  keys and frees the device reservation; :meth:`~PagedKVCache.resume`
+  re-admits it under a new id through ``import_blocks``, adopting
+  whatever prefix is still on device. The CRC/base64 encode can run
+  OFF the drive thread through the async-ckpt double-buffer idiom
+  (:class:`_OffloadWorker`); the record's ready event gates readers.
+
 Capacity failures are a typed :class:`AdmissionError` carrying the
 needed/free block counts — an admission-control signal the engine (or a
 load balancer above it) can act on, categorically different from an
@@ -68,14 +90,83 @@ quiescent point.
 from __future__ import annotations
 
 import base64
+import queue
+import threading
 import zlib
 from collections import OrderedDict
-from typing import Any, Dict, List, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from tony_tpu.serve.disagg import HandoffError
+
+
+def _encode_payload(kb: bytes, vb: bytes) -> Dict[str, Any]:
+    """One block's wire/host payload from its raw k/v bytes — the ONE
+    encoder the handoff wire, the demoted host tier, and the parked
+    records all share, so every tier speaks the identical CRC-guarded
+    form and :meth:`PagedKVCache._decode_block` verifies them all."""
+    return {"k": base64.b64encode(kb).decode("ascii"),
+            "v": base64.b64encode(vb).decode("ascii"),
+            "crc": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF}
+
+
+class _OffloadWorker:
+    """Host-offload encode worker — the async-ckpt double-buffer idiom
+    (:class:`tony_tpu.ckpt.snapshot.AsyncCheckpointer`): the drive
+    thread's batched device fetch hands raw bytes over a queue, this
+    daemon thread runs the CRC/base64 encode, and a bounded semaphore
+    caps in-flight records at two (the double buffer) so parking can
+    never outrun host RAM. Message-passing only: the worker writes
+    into exactly the record it was handed and publishes it by setting
+    the record's ready event (the release half of the happens-before
+    pair — readers wait on the event first), so no pool bookkeeping is
+    ever touched off the drive thread and the concurrency plane's
+    single-driver discipline holds with zero blessings."""
+
+    def __init__(self, slots: int = 2):
+        self._q: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._slots = threading.BoundedSemaphore(slots)
+        # Error slot (AsyncCheckpointer's idiom): a failed encode parks
+        # here and re-raises on the drive thread at the next check().
+        self._err: Optional[BaseException] = None
+        self._err_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="tony-kv-offload", daemon=True)
+        self._thread.start()
+
+    def submit(self, rec: Dict[str, Any],
+               raw: Sequence[Tuple[bytes, bytes]]) -> None:
+        self._slots.acquire()
+        self._q.put((rec, list(raw)))
+
+    def check(self) -> None:
+        """Re-raise (once) any encode failure on the caller's thread."""
+        with self._err_lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise err
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            rec, raw = item
+            try:
+                rec["blocks"] = [_encode_payload(kb, vb)
+                                 for kb, vb in raw]
+            except BaseException as e:  # noqa: BLE001 — parked in the slot
+                with self._err_lock:
+                    self._err = e
+            finally:
+                rec["ready"].set()
+                self._slots.release()
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=10)
 
 
 class AdmissionError(RuntimeError):
@@ -98,7 +189,8 @@ class PagedKVCache:
     """Host-managed block allocator over device-resident KV block pools."""
 
     def __init__(self, n_layers: int, kv_dim: int, *, n_blocks: int,
-                 block_size: int, dtype: Any = jnp.bfloat16):
+                 block_size: int, dtype: Any = jnp.bfloat16,
+                 host_blocks: int = 0, async_offload: bool = False):
         if n_blocks <= 0 or block_size <= 0:
             raise ValueError(f"need positive n_blocks/block_size, got "
                              f"{n_blocks}/{block_size}")
@@ -137,6 +229,22 @@ class PagedKVCache:
         # are drafts that may be rolled back).
         self._spec: Dict[Any, List[int]] = {}
         self._committed: Dict[Any, int] = {}
+        # Host-offload tier (PR 16): host_blocks > 0 arms a host-RAM
+        # tier of wire payloads — demoted stems keyed by chain key
+        # (least-recently-demoted first: the eviction order when the
+        # tier fills) and parked sequences keyed by sequence id. The
+        # counters feed the engine's uniform heartbeat schema.
+        self.host_blocks = int(host_blocks)
+        self._host_index: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
+        self._parked: Dict[Any, Dict[str, Any]] = {}
+        self.demoted_total = 0
+        self.promoted_total = 0
+        self.parked_total = 0
+        self.resumed_total = 0
+        self._offload = (_OffloadWorker()
+                         if async_offload and self.host_blocks > 0
+                         else None)
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -162,6 +270,16 @@ class PagedKVCache:
             key = self._key_of.pop(b, None)
             if key is not None:
                 self._index.pop(key, None)
+                # Host tier armed: spill the evicted content to host
+                # RAM instead of dropping it — the ref-aware LRU order
+                # becomes the demotion policy. Reclaim is stem-only,
+                # so a full host tier degrades to the plain drop,
+                # never an error on the allocation path.
+                if self.host_blocks > 0 and self._host_reclaim(1):
+                    kb, vb = self._fetch_raw([b])[0]
+                    self._host_index[key] = _encode_payload(kb, vb)
+                    self._host_index.move_to_end(key)
+                    self.demoted_total += 1
             self.lru_evicted_total += 1
         self._refs[b] = 1
         return b
@@ -325,6 +443,11 @@ class PagedKVCache:
         verified-written (full block, inside the committed extent)."""
         table = self._tables[seq_id]
         b = table[block_i]
+        # A device copy supersedes a demoted host copy of the same key
+        # (identical bytes by the content-address contract): dropping
+        # the shadow keeps the device/host key partition disjoint —
+        # the invariant the threaded stress pins at every barrier.
+        self._host_index.pop(key, None)
         if key in self._index:
             self._touch_key(self._index[key])
             return False
@@ -380,20 +503,20 @@ class PagedKVCache:
             raise ValueError(
                 f"cannot export {length} positions for {seq_id!r}: only "
                 f"{len(table)} block(s) reserved")
-        ids = np.asarray(table[:nb], np.int32)
-        # One device fetch each for k/v — not one per block.
-        kh = np.asarray(self.k[:, ids])
-        vh = np.asarray(self.v[:, ids])
-        out: List[Dict[str, Any]] = []
-        for i in range(nb):
-            kb = np.ascontiguousarray(kh[:, i]).tobytes()
-            vb = np.ascontiguousarray(vh[:, i]).tobytes()
-            out.append({
-                "k": base64.b64encode(kb).decode("ascii"),
-                "v": base64.b64encode(vb).decode("ascii"),
-                "crc": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF,
-            })
-        return out
+        return [_encode_payload(kb, vb)
+                for kb, vb in self._fetch_raw(table[:nb])]
+
+    def _fetch_raw(self, ids: Sequence[int]) -> List[Tuple[bytes, bytes]]:
+        """Raw host k/v bytes of pool blocks ``ids`` — ONE batched
+        device fetch per pool, not one per block (the export / demote /
+        park fast path; the CRC/base64 encode can then run off the
+        drive thread)."""
+        idx = np.asarray(list(ids), np.int32)
+        kh = np.asarray(self.k[:, idx])
+        vh = np.asarray(self.v[:, idx])
+        return [(np.ascontiguousarray(kh[:, i]).tobytes(),
+                 np.ascontiguousarray(vh[:, i]).tobytes())
+                for i in range(len(idx))]
 
     def _decode_block(self, blk: Dict[str, Any]) -> tuple:
         """Decode + CRC-verify one wire block payload into host
@@ -504,6 +627,225 @@ class PagedKVCache:
             table.append(self._alloc_block())
         self._tables[seq_id] = table
         return len(matched)
+
+    # -- host-offload tier (PR 16) -----------------------------------------
+    @property
+    def host_blocks_used(self) -> int:
+        """Host-tier occupancy in blocks: demoted stems + every parked
+        record's payloads (in-flight async encodes count — their extent
+        is known at submit)."""
+        return len(self._host_index) \
+            + sum(r["n"] for r in self._parked.values())
+
+    def _host_reclaim(self, need: int) -> bool:
+        """Make room for ``need`` more host payloads by dropping the
+        least-recently-demoted stems. Parked records are never victims
+        — parking is an explicit contract with the engine, stem
+        demotion opportunistic. False when the tier cannot hold
+        ``need`` even with every stem dropped."""
+        if need > self.host_blocks:
+            return False
+        while self.host_blocks_used + need > self.host_blocks \
+                and self._host_index:
+            self._host_index.popitem(last=False)
+        return self.host_blocks_used + need <= self.host_blocks
+
+    def demote(self, count: int = 1) -> int:
+        """Demote up to ``count`` least-recently-freed cached-tier
+        blocks to the host tier: ONE batched device fetch, payloads
+        stashed under the blocks' chain keys, device blocks to the
+        LIFO free list. The existing ref-aware LRU order IS the
+        demotion policy — only refcount-0 published blocks live in the
+        cached tier, and the front of the order is the coldest.
+        Returns blocks demoted (0 with the tier off or nothing
+        demotable)."""
+        if self.host_blocks <= 0 or count <= 0:
+            return 0
+        victims = list(self._lru)[:count]
+        if victims and not self._host_reclaim(len(victims)):
+            victims = victims[:max(
+                0, self.host_blocks - self.host_blocks_used)]
+        if not victims:
+            return 0
+        raw = self._fetch_raw(victims)
+        for b, (kb, vb) in zip(victims, raw):
+            key = self._key_of.pop(b)
+            self._index.pop(key, None)
+            del self._lru[b]
+            self._free.append(b)
+            self._host_index[key] = _encode_payload(kb, vb)
+            self._host_index.move_to_end(key)
+        self.demoted_total += len(victims)
+        return len(victims)
+
+    def promote(self, keys: Sequence[str]) -> int:
+        """Re-stage the host-tier run of ``keys`` that picks up where
+        the device index stops matching: CRC-verify every payload
+        FIRST (a corrupt host byte raises :class:`HandoffError` with
+        device and host tiers unchanged), then ONE batched scatter
+        into fresh blocks, indexed refcount-0 in the cached tier — the
+        ``admit_shared`` that follows adopts them like any published
+        stem. Degrades under pool pressure instead of raising: only
+        the LIFO tier is consumed (allocating through LRU eviction
+        could evict — or re-demote — the very chain being promoted)
+        and the run truncates to what fits. Returns blocks promoted."""
+        if self.host_blocks <= 0 or not self._host_index:
+            return 0
+        keys = list(keys)
+        start = len(self.match_prefix(keys))
+        run: List[str] = []
+        for key in keys[start:]:
+            if key not in self._host_index:
+                break
+            run.append(key)
+        run = run[:len(self._free)]
+        if not run:
+            return 0
+        arrs = [self._decode_block(self._host_index[k]) for k in run]
+        dsts = [self._free.pop() for _ in run]
+        idx = jnp.asarray(dsts)
+        self.k = self.k.at[:, idx].set(
+            jnp.asarray(np.stack([a[0] for a in arrs], axis=1)))
+        self.v = self.v.at[:, idx].set(
+            jnp.asarray(np.stack([a[1] for a in arrs], axis=1)))
+        for key, b in zip(run, dsts):
+            del self._host_index[key]
+            self._index[key] = b
+            self._key_of[b] = key
+            self._lru[b] = None
+            self._lru.move_to_end(b)
+        self.promoted_total += len(run)
+        return len(run)
+
+    def discard_host(self, keys: Sequence[str]) -> int:
+        """Drop host-tier stem entries for ``keys`` — the corrupt-
+        payload recovery path (a failed :meth:`promote` must not leave
+        the poison entry to fail every later admission; the rows
+        recompute fresh). Returns entries dropped."""
+        n = 0
+        for key in keys:
+            if self._host_index.pop(key, None) is not None:
+                n += 1
+        return n
+
+    def host_keys(self) -> List[str]:
+        """Demoted-stem chain keys, least-recently-demoted first (test
+        surface for the host-tier partition invariants)."""
+        return list(self._host_index)
+
+    def export_keys(self, keys: Sequence[str]) -> List[Dict[str, Any]]:
+        """Wire payloads of the device blocks indexed under ``keys``
+        (every key must be indexed — the persistent prefix store only
+        persists fully-on-device chains). ONE batched fetch, read-only."""
+        ids: List[int] = []
+        for key in keys:
+            b = self._index.get(key)
+            if b is None:
+                raise KeyError(f"chain key {key!r} not indexed")
+            ids.append(b)
+        return [_encode_payload(kb, vb)
+                for kb, vb in self._fetch_raw(ids)]
+
+    def park(self, seq_id: Any, length: int, *,
+             keys: Sequence[str] = ()) -> int:
+        """Park ``seq_id``: ONE batched device fetch of the blocks
+        covering ``length`` positions, stashed with the full blocks'
+        chain ``keys`` (the resume-time adoption probe) as a host-tier
+        record, then the device reservation is freed. With the async
+        :class:`_OffloadWorker` armed the CRC/base64 encode runs off
+        the drive thread, double-buffered; the record's ready event
+        gates any reader. Raises :class:`AdmissionError` (state
+        unchanged) when the tier is off or cannot hold the record —
+        the engine then falls back to a plain eviction."""
+        if seq_id in self._parked:
+            raise ValueError(f"sequence {seq_id!r} is already parked")
+        table = self._tables.get(seq_id)
+        nb = self.blocks_for(length)
+        if table is None or nb > len(table):
+            raise ValueError(
+                f"cannot park {length} positions for {seq_id!r}: "
+                f"{0 if table is None else len(table)} block(s) held")
+        keys = [str(k) for k in keys]
+        if len(keys) != int(length) // self.block_size:
+            raise ValueError(
+                f"park needs one chain key per FULL block: got "
+                f"{len(keys)} for {length} positions "
+                f"(block_size {self.block_size})")
+        if self.host_blocks <= 0 or not self._host_reclaim(nb):
+            raise AdmissionError(
+                f"host tier cannot hold {nb} block(s) for parked "
+                f"sequence {seq_id!r} "
+                f"({self.host_blocks_used}/{self.host_blocks} used)",
+                needed_blocks=nb,
+                free_blocks=max(0, self.host_blocks
+                                - self.host_blocks_used))
+        raw = self._fetch_raw(table[:nb])
+        rec: Dict[str, Any] = {"length": int(length), "keys": keys,
+                               "n": nb, "ready": threading.Event(),
+                               "blocks": None}
+        if self._offload is not None:
+            self._offload.submit(rec, raw)
+        else:
+            rec["blocks"] = [_encode_payload(kb, vb) for kb, vb in raw]
+            rec["ready"].set()
+        self._parked[seq_id] = rec
+        self.free_seq(seq_id)
+        self.parked_total += 1
+        return nb
+
+    def resume(self, new_id: Any, length: int, parked_id: Any) -> int:
+        """Re-admit parked ``parked_id`` as ``new_id`` covering
+        ``length`` total positions through :meth:`import_blocks`'
+        atomic path: the chain-key prefix still on device is adopted,
+        the rest re-stages from the host payloads (CRC-verified before
+        any bookkeeping moves), the remainder of the reservation
+        allocates fresh. The record is consumed only on success:
+        :class:`HandoffError` (host corruption) and
+        :class:`AdmissionError` (pool pressure) leave the pool AND the
+        record unchanged, so the caller can degrade to a re-prefill —
+        typed and counted, never wedged. Returns device blocks
+        adopted."""
+        rec = self._parked.get(parked_id)
+        if rec is None:
+            raise KeyError(f"no parked sequence {parked_id!r}")
+        rec["ready"].wait()
+        if self._offload is not None:
+            self._offload.check()
+        if rec["blocks"] is None:
+            raise HandoffError(
+                f"parked sequence {parked_id!r} lost its host payloads "
+                f"(offload encode failed)", retryable=False)
+        keys = rec["keys"]
+        matched = len(self.match_prefix(keys))
+        adopted = self.import_blocks(
+            new_id, length, rec["blocks"][matched:], keys=keys,
+            offset=matched)
+        del self._parked[parked_id]
+        self.resumed_total += 1
+        return adopted
+
+    def unpark(self, parked_id: Any) -> int:
+        """Drop a parked record (conversation diverged, engine-side
+        degrade to re-prefill, or a re-park of the same conversation).
+        Idempotent; waits out an in-flight async encode so the record
+        is never orphaned mid-write. Returns host blocks released."""
+        rec = self._parked.pop(parked_id, None)
+        if rec is None:
+            return 0
+        rec["ready"].wait()
+        return rec["n"]
+
+    def parked_ids(self) -> List[Any]:
+        """Parked sequence ids (test + digest surface)."""
+        return list(self._parked)
+
+    def close(self) -> None:
+        """Join the async offload worker — the thread-hygiene contract
+        (whoever builds an async-armed cache owns its teardown; the
+        sync default owns no thread and this is a no-op)."""
+        if self._offload is not None:
+            self._offload.close()
+            self._offload = None
 
     # -- speculative tier (tony_tpu.serve.spec) ----------------------------
     def committed_len(self, seq_id: Any) -> int:

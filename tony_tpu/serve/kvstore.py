@@ -1,0 +1,151 @@
+"""Persistent prefix store: hot published KV stems on disk, keyed by
+chain hash.
+
+The serving fleet's hottest KV bytes are its shared prompt stems (system
+prompts, few-shot preambles) — content-addressed by the prefix tier's
+chain keys (:mod:`tony_tpu.serve.prefix`), adopted by every conversation
+that shares them. But the prefix tier dies with its replica: a fresh
+replica, and every scale-up grant the AM launches, re-prefills stems the
+fleet computed thousands of times already. This module persists them
+through the ckpt plane's commit discipline so a cold replica warms from
+disk instead of recompute:
+
+* one directory per stem — ``stem_<tip>/`` where ``<tip>`` is the
+  chain's LAST key (chain hashing makes the tip name the whole chain:
+  two different prefixes cannot share a tip);
+* inside, ``blocks.bin`` (each block's raw k bytes then v bytes,
+  concatenated) plus a ``stem.json`` manifest carrying the chain keys,
+  the pool geometry header, and a per-block chunk table ``{offset,
+  nbytes, k_nbytes, crc32}`` — the ckpt sidecar idiom
+  (:mod:`tony_tpu.ckpt.format`), and the CRC is bit-identical to the
+  handoff wire's ``crc32(k_bytes + v_bytes)`` (zlib's running-CRC
+  identity), so one checksum guards a block from device fetch through
+  disk and back;
+* commit is stage + atomic rename: payload and manifest are written
+  (fsynced) into ``stem_<tip>.tmp`` and ``os.replace``d into place —
+  a crashed writer leaves a ``.tmp`` orphan, never a half stem, and
+  :meth:`PrefixStore.get` re-verifies every chunk CRC on read.
+
+Jax-free by the same layering rule as ``serve.prefix``: the AM names
+the store in a scale-up grant and the replica loads it at startup —
+only the latter ever touches a device.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import zlib
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from tony_tpu.ckpt.format import TMP_SUFFIX, _atomic_write_json, _fsync_dir
+
+_PREFIX = "stem_"
+FORMAT = "tony-kvstem-v1"
+
+
+class PrefixStore:
+    """One directory of persisted KV stems (see module docstring).
+
+    ``put``/``get`` speak the handoff wire's block payload form —
+    ``{"k": b64, "v": b64, "crc": int}`` — so the engine's existing
+    export (:meth:`~tony_tpu.serve.kvcache.PagedKVCache.export_keys`)
+    and import (:meth:`~tony_tpu.serve.engine.ServeEngine.adopt_stem`)
+    paths ARE the store's serialization, CRC discipline included."""
+
+    def __init__(self, root: str) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _dir(self, tip: str) -> Path:
+        return self.root / f"{_PREFIX}{tip}"
+
+    def stems(self) -> List[str]:
+        """Committed stem tips, sorted (``.tmp`` orphans excluded)."""
+        out = []
+        for entry in sorted(os.listdir(self.root)):
+            if entry.startswith(_PREFIX) \
+                    and not entry.endswith(TMP_SUFFIX):
+                out.append(entry[len(_PREFIX):])
+        return out
+
+    def put(self, keys: Sequence[str], blocks: Sequence[Dict[str, Any]],
+            header: Dict[str, Any]) -> bool:
+        """Persist one stem: ``keys`` the chain, ``blocks`` its wire
+        payloads, ``header`` the pool geometry (:meth:`~tony_tpu.serve.
+        kvcache.PagedKVCache.wire_header`). Idempotent per tip — a
+        committed stem is immutable (same tip = same chain = same
+        content) and re-puts return False. Every payload's CRC is
+        verified BEFORE any byte lands on disk; a corrupt payload
+        raises ``ValueError`` with nothing written."""
+        keys = [str(k) for k in keys]
+        if not keys or len(keys) != len(blocks):
+            raise ValueError(f"stem needs one payload per chain key: "
+                             f"{len(keys)} key(s), {len(blocks)} block(s)")
+        final = self._dir(keys[-1])
+        if final.exists():
+            return False
+        raws: List[bytes] = []
+        table: List[Dict[str, Any]] = []
+        offset = 0
+        for i, blk in enumerate(blocks):
+            kb = base64.b64decode(blk["k"])
+            vb = base64.b64decode(blk["v"])
+            crc = zlib.crc32(kb + vb) & 0xFFFFFFFF
+            if crc != int(blk["crc"]):
+                raise ValueError(
+                    f"stem block {i} CRC mismatch (got {crc:#010x}, "
+                    f"payload claims {int(blk['crc']):#010x}) — "
+                    f"refusing to persist corrupt KV")
+            raws.append(kb + vb)
+            table.append({"offset": offset, "nbytes": len(kb) + len(vb),
+                          "k_nbytes": len(kb), "crc32": crc})
+            offset += len(kb) + len(vb)
+        staging = Path(f"{final}{TMP_SUFFIX}")
+        staging.mkdir(parents=True, exist_ok=True)
+        with open(staging / "blocks.bin", "wb") as f:
+            for raw in raws:
+                f.write(raw)
+            f.flush()
+            os.fsync(f.fileno())
+        _atomic_write_json(staging / "stem.json", {
+            "format": FORMAT, "keys": keys,
+            "header": dict(header), "chunks": table})
+        os.replace(staging, final)
+        _fsync_dir(self.root)
+        return True
+
+    def get(self, tip: str) -> Optional[Dict[str, Any]]:
+        """Load one stem back into wire form: ``{"keys": [...],
+        "header": {...}, "blocks": [wire payloads]}`` — ready for
+        ``adopt_stem``. Every chunk CRC re-verifies on read (the
+        ChunkReader discipline); a corrupt or missing stem returns
+        ``None`` — the store is a warm-start cache, and a bad entry
+        means recompute, never a crash."""
+        d = self._dir(tip)
+        try:
+            with open(d / "stem.json") as f:
+                manifest = json.load(f)
+            if manifest.get("format") != FORMAT:
+                return None
+            blocks: List[Dict[str, Any]] = []
+            with open(d / "blocks.bin", "rb") as f:
+                for chunk in manifest["chunks"]:
+                    f.seek(int(chunk["offset"]))
+                    raw = f.read(int(chunk["nbytes"]))
+                    if len(raw) != int(chunk["nbytes"]) or \
+                            (zlib.crc32(raw) & 0xFFFFFFFF) \
+                            != int(chunk["crc32"]):
+                        return None
+                    kn = int(chunk["k_nbytes"])
+                    blocks.append({
+                        "k": base64.b64encode(raw[:kn]).decode("ascii"),
+                        "v": base64.b64encode(raw[kn:]).decode("ascii"),
+                        "crc": int(chunk["crc32"])})
+            return {"keys": list(manifest["keys"]),
+                    "header": dict(manifest["header"]),
+                    "blocks": blocks}
+        except (OSError, ValueError, KeyError, TypeError):
+            return None

@@ -38,9 +38,10 @@ from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
                            SERVE_CTX_MAX, SERVE_DRAFT_CKPT_DIR,
                            SERVE_DRAFT_MODEL, SERVE_DRAFT_MODEL_KWARGS,
                            SERVE_DRAFT_NGRAM_MAX, SERVE_DTYPE_POLICY,
-                           SERVE_MAX_RUNNING, SERVE_MESH, SERVE_MODEL,
-                           SERVE_MODEL_KWARGS, SERVE_PORT,
-                           SERVE_PREFILL_CHUNK, SERVE_PREFIX_CACHE,
+                           SERVE_HOST_BLOCKS, SERVE_MAX_RUNNING,
+                           SERVE_MESH, SERVE_MODEL, SERVE_MODEL_KWARGS,
+                           SERVE_PORT, SERVE_PREFILL_CHUNK,
+                           SERVE_PREFIX_CACHE, SERVE_PREFIX_STORE,
                            SERVE_SPEC_K, serve_role_key)
 from tony_tpu.serve.engine import Completion, EngineFront, ServeEngine
 
@@ -62,7 +63,8 @@ class Replica:
                  ngram_max: int = 3,
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 role: str = "colocated"):
+                 role: str = "colocated", host_blocks: int = 0,
+                 prefix_store: Optional[str] = None):
         from tony_tpu._trace import trace_record
         from tony_tpu.models import get_model
         from tony_tpu.serve.disagg import DecodeFront, PrefillFront
@@ -96,7 +98,8 @@ class Replica:
                 max_running=max_running, mesh=mesh,
                 keep_logits=keep_logits, tag=tag,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-                role=role, **draft_kw)
+                role=role, host_blocks=host_blocks,
+                async_offload=host_blocks > 0, **draft_kw)
         else:
             self.engine = ServeEngine(
                 self.model, params, ctx_max=ctx_max,
@@ -104,7 +107,8 @@ class Replica:
                 max_running=max_running, mesh=mesh,
                 keep_logits=keep_logits, tag=tag,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-                role=role)
+                role=role, host_blocks=host_blocks,
+                async_offload=host_blocks > 0)
         trace_record("serve", "replica", model=model_name,
                      ckpt_step=step, path_prefix=prefix,
                      dtype_policy=dtype_policy, spec_k=int(spec_k),
@@ -121,7 +125,33 @@ class Replica:
         # stray kv_offer is harmless — capability is not policy.
         self._prefill_front = PrefillFront(self._front)
         self._decode_front = DecodeFront(self._front)
+        # Persistent prefix store (tony_tpu.serve.kvstore): adopt the
+        # persisted hot stems NOW — before the first request — so a
+        # fresh replica (or a scale-up grant naming the store) serves
+        # its first shared-stem prompt from disk-warmed KV instead of
+        # recompute; the stats publisher exports newly-hot stems back.
+        self._store = None
+        if prefix_store:
+            from tony_tpu.serve.kvstore import PrefixStore
+
+            self._store = PrefixStore(prefix_store)
+            self._load_stems()
         self.port: Optional[int] = None
+
+    def _load_stems(self) -> None:
+        """Warm the engine's prefix tier from the store — best-effort:
+        a corrupt or geometry-skewed stem is skipped (that prefix
+        recomputes), never a startup failure."""
+        header = self.engine.cache.wire_header()
+        adopted = 0
+        for tip in self._store.stems():
+            rec = self._store.get(tip)
+            if rec is None or rec.get("header") != header:
+                continue
+            adopted += self.engine.adopt_stem(rec["keys"], rec["blocks"])
+        if adopted:
+            print(f"[tony-serve-replica] adopted {adopted} KV block(s) "
+                  f"from the prefix store", flush=True)
 
     @staticmethod
     def _restore_params(model: Any, ckpt_dir: str, *,
@@ -164,23 +194,27 @@ class Replica:
 
     # -- request path ------------------------------------------------------
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
-                 rid: Optional[Any] = None) -> Completion:
+                 rid: Optional[Any] = None,
+                 conv: Optional[Any] = None) -> Completion:
         """Submit one request and drive the shared engine until it
         completes. Thread-safe: concurrent callers interleave on the
         drive lock (:class:`~tony_tpu.serve.engine.EngineFront` — the
         same loop the router's in-process transport runs), so their
-        requests ride one continuous batch."""
-        return self._front.generate(tokens, max_new_tokens, rid=rid)
+        requests ride one continuous batch. ``conv`` is the
+        conversation handle arming park/resume on a host-tier engine."""
+        return self._front.generate(tokens, max_new_tokens, rid=rid,
+                                    conv=conv)
 
     # -- disaggregated handoff (tony_tpu.serve.disagg) ---------------------
     def prefill_handoff(self, tokens: Sequence[int], max_new_tokens: int,
                         rid: Optional[Any] = None,
-                        decode: Any = None) -> Completion:
+                        decode: Any = None,
+                        conv: Optional[Any] = None) -> Completion:
         """Prefill-role request path: prefill ``tokens``, ship the KV
         blocks to ``decode`` (an address or an in-process receiver),
         return the completion the decode side drove to the end."""
         return self._prefill_front.prefill_handoff(
-            tokens, max_new_tokens, rid=rid, decode=decode)
+            tokens, max_new_tokens, rid=rid, decode=decode, conv=conv)
 
     def kv_offer(self, keys: Sequence[str]) -> int:
         return self._decode_front.kv_offer(keys)
@@ -219,6 +253,15 @@ class Replica:
                     stats_path, extra={"rpc_port": server.port})
             except OSError:
                 pass
+            if self._store is not None:
+                # Persist newly-hot stems on the publish cadence —
+                # under the drive lock (the export reads the pool,
+                # and the pool is only safe under one driver).
+                try:
+                    with self._front._drive:
+                        self.engine.export_stems(self._store)
+                except OSError:
+                    pass
 
         try:
             # First publish BEFORE the first interval: the router can
@@ -231,9 +274,11 @@ class Replica:
         finally:
             # Deterministic teardown (the concurrency plane's shutdown-
             # hygiene contract): server.stop() joins the accept thread,
+            # and cache.close() joins the host-offload encode worker,
             # so by the time serve_forever returns no replica thread is
             # left running.
             server.stop()
+            self.engine.cache.close()
 
 
 class _ReplicaRpcHandler:
@@ -247,9 +292,10 @@ class _ReplicaRpcHandler:
         return c.wire()
 
     def rpc_generate(self, tokens: List[int], max_new_tokens: int = 16,
-                     rid: Optional[str] = None) -> Dict[str, Any]:
+                     rid: Optional[str] = None,
+                     conv: Optional[str] = None) -> Dict[str, Any]:
         return self._wire(self.replica.generate(tokens, max_new_tokens,
-                                                rid=rid))
+                                                rid=rid, conv=conv))
 
     def rpc_serve_stats(self) -> Dict[str, float]:
         return self.replica.engine.stats()
@@ -258,7 +304,8 @@ class _ReplicaRpcHandler:
     def rpc_prefill_handoff(self, tokens: List[int],
                             max_new_tokens: int = 16,
                             rid: Optional[str] = None,
-                            decode_address: Optional[str] = None
+                            decode_address: Optional[str] = None,
+                            conv: Optional[str] = None
                             ) -> Dict[str, Any]:
         """The router's disaggregated dispatch verb: prefill here, ship
         the KV replica-to-replica to ``decode_address``, return the
@@ -266,7 +313,8 @@ class _ReplicaRpcHandler:
         ``"HandoffError: ..."`` on the JSON-lines wire — the router
         re-types them for its fallback split."""
         out = self.replica.prefill_handoff(tokens, max_new_tokens,
-                                           rid=rid, decode=decode_address)
+                                           rid=rid, decode=decode_address,
+                                           conv=conv)
         return out if isinstance(out, dict) else self._wire(out)
 
     def rpc_kv_offer(self, keys: List[str]) -> int:
@@ -324,7 +372,9 @@ def main() -> int:
         ngram_max=conf.get_int(SERVE_DRAFT_NGRAM_MAX, 3),
         prefix_cache=conf.get_bool(SERVE_PREFIX_CACHE, False),
         prefill_chunk=conf.get_int(SERVE_PREFILL_CHUNK, 0) or None,
-        role=role)
+        role=role,
+        host_blocks=conf.get_int(SERVE_HOST_BLOCKS, 0),
+        prefix_store=conf.get(SERVE_PREFIX_STORE) or None)
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

@@ -101,6 +101,11 @@ class ReplicaView:
     running: float = 0.0
     p99_ms: float = 0.0
     digest: frozenset = frozenset()
+    # Parked-conversation handles (PR 16): the conversations whose KV
+    # this replica holds in its host-offload tier — a returning turn
+    # re-pinned here resumes without a re-prefill, so the parked set
+    # outranks the overlap score for its own conversations.
+    parked: frozenset = frozenset()
     # Disaggregated-serving role (tony_tpu.serve.disagg): "prefill" /
     # "decode" replicas split the request into a prefill dispatch and a
     # KV handoff target; "colocated" (every pre-PR 15 replica) serves
@@ -117,6 +122,9 @@ class ReplicaView:
         digest = stats.get("prefix_digest")
         if digest is not None:
             self.digest = frozenset(str(k) for k in digest)
+        parked = stats.get("parked_digest")
+        if parked is not None:
+            self.parked = frozenset(str(c) for c in parked)
         role = stats.get("role")
         if isinstance(role, str) and role:
             self.role = role
@@ -176,6 +184,7 @@ class RequestRouter:
         self.cache_routed = 0            # decisions won on overlap > 0
         self.handoffs = 0                # disaggregated dispatches
         self.handoff_fallbacks = 0       # handoff failed -> colocated
+        self.park_pins = 0               # re-pins onto parked KV
 
     # -- membership --------------------------------------------------------
     def upsert_replica(self, name: str, *, address: Optional[str] = None,
@@ -266,6 +275,18 @@ class RequestRouter:
             if not live:
                 raise NoReplicaError(
                     f"no live replica among {len(self._replicas)} known")
+            if session_id is not None:
+                # Affinity missed (router restart, pin dropped on a
+                # failover) but a replica still HOLDS the conversation
+                # parked in its host tier — re-pin there: a resume
+                # skips the whole shared-history prefill, which beats
+                # any overlap score the scoring below could produce.
+                sid = str(session_id)
+                for v in sorted(live, key=lambda v: v.name):
+                    if sid in v.parked:
+                        self.park_pins += 1
+                        self._affinity[session_id] = v.name
+                        return v.name
             best = max(live, key=lambda v: (score(self.policy, v, keys),
                                             v.name))
             if keys and best.digest \
@@ -378,6 +399,11 @@ class RequestRouter:
         errors (AdmissionError/RpcError) still propagate untouched."""
         last_err: Optional[Exception] = None
         split_gone = False
+        # conv rides the handoff payload to the decode engine (and the
+        # fallback's colocated generate) — the decode replica is where
+        # the conversation's generated KV lives, so it is the one that
+        # parks and resumes it.
+        kw = {} if session_id is None else {"conv": str(session_id)}
         for _ in range(max(1, int(max_attempts))):
             pf, dc = self.route_split(tokens, session_id)
             if pf is None:
@@ -390,7 +416,7 @@ class RequestRouter:
             try:
                 out = self._client_of(pf).prefill_handoff(
                     [int(t) for t in tokens], int(max_new_tokens),
-                    rid=rid, decode=self._decode_target(dc))
+                    rid=rid, decode=self._decode_target(dc), **kw)
                 with self._lock:
                     self.handoffs += 1
             except OSError as e:        # prefill transport fault
@@ -415,7 +441,8 @@ class RequestRouter:
                     # caller's rid is restored on the response below.
                     out = self._client_of(dc).generate(
                         [int(t) for t in tokens], int(max_new_tokens),
-                        rid=None if rid is None else f"{rid}~fallback")
+                        rid=None if rid is None else f"{rid}~fallback",
+                        **kw)
                 except OSError as e2:   # decode transport fault
                     last_err = e2
                     with self._lock:
@@ -482,12 +509,19 @@ class RequestRouter:
                             rid: Optional[Any] = None,
                             max_attempts: int = 3) -> Dict[str, Any]:
         last_err: Optional[Exception] = None
+        # The session id doubles as the engine-side conversation handle
+        # (conv): a host-tier replica parks the turn's KV under it and
+        # the next turn — re-pinned here by affinity or the parked
+        # digest — resumes instead of re-prefilling. Sessionless
+        # requests ship no kwarg, so pre-PR 16 client stubs keep
+        # working unchanged.
+        kw = {} if session_id is None else {"conv": str(session_id)}
         for _ in range(max(1, int(max_attempts))):
             name = self.route(tokens, session_id)
             try:
                 out = self._client_of(name).generate(
                     list(int(t) for t in tokens), int(max_new_tokens),
-                    rid=rid)
+                    rid=rid, **kw)
             except OSError as e:    # transport fault (ConnectionError,
                 last_err = e        # timeout, refused dial, ...)
                 with self._lock:
@@ -520,6 +554,7 @@ class RequestRouter:
                 "cache_routed": float(self.cache_routed),
                 "handoffs": float(self.handoffs),
                 "handoff_fallbacks": float(self.handoff_fallbacks),
+                "park_pins": float(self.park_pins),
                 "sessions": float(len(self._affinity)),
             }
 
@@ -532,14 +567,14 @@ def _rpc_dial(address: str, timeout: float) -> Any:
     from tony_tpu.rpc import RpcClient, RpcError
 
     class _Front:
-        def generate(self, tokens, max_new_tokens, rid=None):
+        def generate(self, tokens, max_new_tokens, rid=None, conv=None):
             with RpcClient(address, timeout=timeout) as client:
                 return client.call("generate", tokens=tokens,
                                    max_new_tokens=max_new_tokens,
-                                   rid=rid)
+                                   rid=rid, conv=conv)
 
         def prefill_handoff(self, tokens, max_new_tokens, rid=None,
-                            decode=None):
+                            decode=None, conv=None):
             # ``decode`` crosses the wire as an address — the prefill
             # REPLICA ships the fat KV payload replica-to-replica; the
             # router only orchestrates. A transported HandoffError
@@ -550,7 +585,8 @@ def _rpc_dial(address: str, timeout: float) -> Any:
                 with RpcClient(address, timeout=timeout) as client:
                     return client.call("prefill_handoff", tokens=tokens,
                                        max_new_tokens=max_new_tokens,
-                                       rid=rid, decode_address=decode)
+                                       rid=rid, decode_address=decode,
+                                       conv=conv)
             except RpcError as e:
                 if str(e).startswith("HandoffError:"):
                     raise HandoffError(str(e), retryable=False) from e

@@ -83,8 +83,9 @@ def normalize_serve_telemetry(raw: Dict) -> Dict[str, object]:
     """One normalization for the serve heartbeat schema, shared by the
     executor's stats-file reader and the session's heartbeat ingest so
     the two layers cannot drift: scalars become floats, list values
-    (the router's ``prefix_digest`` block-key list) become string
-    lists, and non-numeric strings (the disaggregated replica ``role``
+    (the router's ``prefix_digest`` block-key list and the parked-
+    conversation ``parked_digest`` list) become string lists, and
+    non-numeric strings (the disaggregated replica ``role``
     — the schema's second non-scalar) pass through as strings. Numeric
     strings still normalize to float, so a stats writer that
     stringified a counter keeps its historical behavior. Raises on
