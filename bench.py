@@ -311,6 +311,22 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["kvtier_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+
+    if os.environ.get("BENCH_COLDSTART", "1") != "0":
+        # Replica cold-start leg (tony_tpu.ckpt.aot, PR 17): grant→
+        # first-token for a cold replica (trace+compile, cache
+        # populate) vs a cache-hit replica (deserialize-only — ZERO
+        # fresh compiles, counter-pinned) vs a warm standby (promote +
+        # first request), with the build/warm/first-token wall split
+        # broken out and token identity gated bitwise across all three
+        # starts. CPU compile walls understate the TPU win
+        # (coldstart_sim_note); BENCH_r17.
+        try:
+            from tony_tpu.benchmark import run_coldstart_bench
+            result.update(run_coldstart_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["coldstart_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

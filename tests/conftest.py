@@ -53,6 +53,7 @@ _TIER_BY_MODULE = {
     "test_route": "jit",
     "test_disagg": "jit",
     "test_kvtier": "jit",
+    "test_aot": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

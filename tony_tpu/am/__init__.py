@@ -329,17 +329,29 @@ class ApplicationMaster:
                                   for j in serve_jts})
                 self._serve_scale_last[jt] = None
             policy = self._serve_policy[jt]
+            # Partition the live gang: warm STANDBYS (heartbeating
+            # warm_standby — the cold-start plane's compiled-and-idle
+            # pool, tony_tpu.ckpt.aot) are held capacity, not serving
+            # replicas. The load policy sees ONLY the active set; the
+            # pool has its own target (decide_warm) below.
             live = [t for t in session.tasks()
                     if t.job_type == jt and not t.status.is_terminal]
+            warm = [t for t in live
+                    if t.serve_metrics.get("warm_standby")]
+            active = [t for t in live
+                      if not t.serve_metrics.get("warm_standby")]
             # Floor REPAIR runs even when autoscale is off: `tony serve`
             # disables fail-fast on the promise that a crashed replica
             # gets replaced, so below-floor recovery must not hide
             # behind the max>min autoscale arming.
-            if not policy.enabled and len(live) >= policy.min_replicas:
+            warm_target = self._serve_warm_target(jt)
+            if not policy.enabled and len(active) >= policy.min_replicas \
+                    and warm_target <= 0:
                 continue
             now = time.monotonic()
-            delta = scaling.decide(policy, len(live),
-                                   session.serve_samples(jt), now=now,
+            samples = [s for s in session.serve_samples(jt)
+                       if not s.get("warm_standby")]
+            delta = scaling.decide(policy, len(active), samples, now=now,
                                    last_action=self._serve_scale_last[jt])
             if delta > 0:
                 # The grant names the prefix store (when conf declares
@@ -350,26 +362,104 @@ class ApplicationMaster:
                     conf_mod.SERVE_PREFIX_STORE, "") or ""
                 store_note = f", prefix store {store}" if store else ""
                 for _ in range(delta):
+                    # A warm standby PROMOTES in place of a cold grant:
+                    # one RPC flips it active — executables and prefix
+                    # stems already hot. Cold launch is the fallback
+                    # (no pool, or the promote RPC failed).
+                    if warm and self._promote_standby(jt, warm, active):
+                        continue
                     task = session.add_task(jt)
                     self._log(f"serve scale-up -> launching elastic "
                               f"replica {task.task_id} "
-                              f"({len(live) + 1} live{store_note})")
+                              f"({len(active) + 1} active{store_note})")
                     self._try_launch(session, jt, task.index)
                 self._serve_scale_last[jt] = now
             elif delta < 0:
-                victims = sorted((t for t in live if t.elastic),
+                victims = sorted((t for t in active if t.elastic),
                                  key=lambda t: t.index, reverse=True)
                 if victims:
                     victim = victims[0]
                     self._log(f"serve scale-down -> retiring elastic "
                               f"replica {victim.task_id} "
-                              f"({len(live) - 1} live)")
+                              f"({len(active) - 1} active)")
                     session.mark_scaled_down(
                         victim, "replica scale-down (load below floor)")
                     c = self._containers.get(victim.task_id)
                     if c is not None and c.is_running:
                         self.scheduler.stop_container(c)
                     self._serve_scale_last[jt] = now
+            # Warm-pool backfill AFTER the load verdict applied: grants
+            # above the configured instance count self-identify as
+            # standbys (replica.main), so a backfill launch comes up
+            # compiled-and-idle; over-target pools (ceiling shrank, or
+            # a promotion left a retiring active) drain newest-first.
+            warm_delta = scaling.decide_warm(
+                policy, warm_target, len(active), len(warm))
+            if warm_delta > 0:
+                for _ in range(warm_delta):
+                    task = session.add_task(jt)
+                    self._log(f"serve warm-pool -> launching standby "
+                              f"replica {task.task_id} "
+                              f"({len(warm) + 1}/{warm_target} warm)")
+                    self._try_launch(session, jt, task.index)
+            elif warm_delta < 0:
+                pool = sorted((t for t in warm if t.elastic),
+                              key=lambda t: t.index, reverse=True)
+                for victim in pool[:-warm_delta]:
+                    self._log(f"serve warm-pool -> retiring standby "
+                              f"replica {victim.task_id}")
+                    session.mark_scaled_down(
+                        victim, "warm-standby pool over target")
+                    c = self._containers.get(victim.task_id)
+                    if c is not None and c.is_running:
+                        self.scheduler.stop_container(c)
+
+    def _serve_warm_target(self, job_type: str) -> int:
+        """Configured warm-standby pool size for one serve jobtype —
+        the per-gang ``tony.serve.warm-standby.<jobtype>`` override,
+        else the global key, else 0 (pool off)."""
+        v = self.conf.get(conf_mod.serve_warm_standby_key(job_type))
+        if v is None:
+            v = self.conf.get(conf_mod.SERVE_WARM_STANDBY)
+        try:
+            return int(v or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _promote_standby(self, job_type: str, warm: list,
+                         active: list) -> bool:
+        """Flip one warm standby active over its promote RPC (oldest
+        first — it has donated stems longest). On success the task
+        moves from ``warm`` to ``active`` in place so a multi-step
+        delta keeps promoting; on RPC failure the standby stays pooled
+        (its next heartbeat still says warm) and the caller falls back
+        to a cold grant."""
+        from tony_tpu.rpc import RpcClient, RpcError
+
+        task = sorted(warm, key=lambda t: t.index)[0]
+        port = task.serve_metrics.get("rpc_port")
+        if not task.host or not port:
+            return False
+        try:
+            with RpcClient(f"{task.host}:{int(port)}",
+                           timeout=5.0) as client:
+                client.call("promote")
+        except (OSError, ValueError, RpcError) as e:
+            self._log(f"serve scale-up -> promote RPC to "
+                      f"{task.task_id} failed ({e}); cold-granting")
+            return False
+        # Reflect the promotion NOW (the replica republished stats, but
+        # that lands on the next heartbeat): the session's view flips
+        # with it so serve_endpoints routes the promoted replica this
+        # tick.
+        task.serve_metrics = dict(task.serve_metrics,
+                                  warm_standby=0.0)
+        warm.remove(task)
+        active.append(task)
+        self._log(f"serve scale-up -> promoted warm standby "
+                  f"{task.task_id} ({len(active)} active, "
+                  f"{len(warm)} warm)")
+        return True
 
     def _collect_traces_later(self, session: TonySession,
                               delay_s: float) -> None:

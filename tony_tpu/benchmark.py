@@ -2046,3 +2046,166 @@ def run_kvtier_bench(*, n_conversations: int | None = None,
             "kvtier_numerics_ok (bitwise identical streams). Metal "
             "wall latency rides the real-hardware debt list (ROADMAP)")
     return out
+
+
+def run_coldstart_bench(*, seed: int = 0,
+                        on_tpu: bool | None = None) -> dict:
+    """Replica cold-start leg (tony_tpu.ckpt.aot PR 17): grant→first-
+    token for three replica starts against the SAME workload — a COLD
+    replica (empty AOT cache: every step program traces and compiles at
+    warm time, populating the cache), a CACHE-HIT replica (same
+    fingerprints: warm() deserializes persisted executables in
+    milliseconds and the start executes ZERO fresh traces or compiles —
+    counter-pinned), and a WARM-STANDBY replica (compiled ahead of the
+    clock; its grant cost is one promote() RPC plus the first request).
+
+    The wall split is broken out per start: engine build, warm (further
+    split by the engine's own compile_ms vs deserialize_ms ledgers),
+    and first-token. The machine-independent claims are the cache
+    counters (hit start: ``fresh_compiles == 0`` AND the raw-jit memo
+    stays EMPTY — nothing traced) and token identity: all three starts'
+    streams are bitwise equal, logits included. XLA-CPU compile walls
+    stand in for TPU compile walls (``coldstart_sim_note``)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import flax.linen as nn
+
+    from tony_tpu.ckpt.aot import AOTCache
+    from tony_tpu.models import get_model
+    from tony_tpu.serve import Request, ServeEngine
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    model = get_model("llama-tiny", n_layers=2)
+    toks0 = jnp.zeros((1, 16), jnp.int32)
+    params = nn.unbox(model.init(jax.random.PRNGKey(seed), toks0))["params"]
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        params)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, 200, size=n).tolist() for n in (5, 3, 9)]
+    max_new = 6
+    root = tempfile.mkdtemp(prefix="tony_coldstart_bench_")
+
+    def build(tag: str, **kw) -> ServeEngine:
+        # One decode bucket + prompts under one q_block: the FULL step
+        # family is two programs — (4, 16) decode/verify and (1, 16)
+        # monolithic prefill — so warm(prefill_pads=(16,)) provably
+        # covers every shape the drive launches.
+        return ServeEngine(model, params, ctx_max=128, block_size=8,
+                           q_block=16, decode_buckets=(4,),
+                           max_running=4, keep_logits=True,
+                           aot_cache=AOTCache(root),
+                           tag=f"coldstart_bench_{tag}", **kw)
+
+    def first_token_ms(eng) -> float:
+        t0 = time.perf_counter()
+        eng.submit(Request(rid="probe", tokens=list(prompts[0]),
+                           max_new_tokens=1))
+        done = list(eng.run())
+        assert len(done) == 1 and len(done[0].tokens) == 1
+        return 1e3 * (time.perf_counter() - t0)
+
+    def drive(eng) -> dict:
+        for i, p in enumerate(prompts):
+            eng.submit(Request(rid=i, tokens=list(p),
+                               max_new_tokens=max_new))
+        return {c.rid: c for c in eng.run()}
+
+    def start(tag: str, **kw) -> tuple:
+        """One replica start: build + warm + first token, timed."""
+        t0 = time.perf_counter()
+        eng = build(tag, **kw)
+        build_ms = 1e3 * (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        warmed = eng.warm(prefill_pads=(16,))
+        warm_ms = 1e3 * (time.perf_counter() - t0)
+        ft_ms = first_token_ms(eng)
+        return eng, {
+            f"coldstart_{tag}_build_ms": round(build_ms, 2),
+            f"coldstart_{tag}_warm_ms": round(warm_ms, 2),
+            f"coldstart_{tag}_warm_programs": warmed,
+            f"coldstart_{tag}_compile_ms": round(eng.compile_ms, 2),
+            f"coldstart_{tag}_deserialize_ms":
+                round(eng.deserialize_ms, 2),
+            f"coldstart_{tag}_first_token_ms": round(ft_ms, 2),
+            f"coldstart_{tag}_grant_to_first_token_ms":
+                round(build_ms + warm_ms + ft_ms, 2),
+            f"coldstart_{tag}_fresh_compiles": eng.fresh_compiles,
+            f"coldstart_{tag}_aot_hits": eng.aot_hits,
+            f"coldstart_{tag}_aot_misses": eng.aot_misses,
+        }
+
+    out = {"metric": "coldstart_bench",
+           "backend": jax.default_backend(),
+           "coldstart_max_new_tokens": max_new}
+
+    # Leg 1 — COLD: empty cache, warm pays the full trace+compile wall
+    # AND persists every executable for the fleet.
+    cold, row = start("cold")
+    out.update(row)
+    ref = drive(cold)
+
+    # Leg 2 — CACHE-HIT: a fresh replica on the populated cache. The
+    # acceptance pin: zero fresh traces or compiles across the ENTIRE
+    # start-and-serve — and the raw-jit memo must stay empty (had
+    # anything traced, it would live there).
+    hit, row = start("hit")
+    out.update(row)
+    got_hit = drive(hit)
+    out["coldstart_hit_zero_fresh_compiles"] = (
+        hit.fresh_compiles == 0 and len(hit._fns) == 0)
+
+    # Leg 3 — WARM-STANDBY: compiled ahead of the clock (untimed); the
+    # grant is one promote() flip plus the first request.
+    standby = build("standby", warm_standby=True)
+    standby.warm(prefill_pads=(16,))
+    t0 = time.perf_counter()
+    assert standby.promote()
+    promote_ms = 1e3 * (time.perf_counter() - t0)
+    ft_ms = first_token_ms(standby)
+    out["coldstart_standby_promote_ms"] = round(promote_ms, 4)
+    out["coldstart_standby_first_token_ms"] = round(ft_ms, 2)
+    out["coldstart_standby_grant_to_first_token_ms"] = round(
+        promote_ms + ft_ms, 2)
+    out["coldstart_standby_fresh_compiles"] = standby.fresh_compiles
+    got_standby = drive(standby)
+
+    # Token identity across all three starts — the cache may cost a
+    # compile, never a wrong program.
+    numerics_ok = True
+    for got in (got_hit, got_standby):
+        numerics_ok = numerics_ok and sorted(got) == sorted(ref)
+        for rid in ref:
+            numerics_ok = (numerics_ok
+                           and got[rid].tokens == ref[rid].tokens
+                           and all(np.array_equal(a, b) for a, b in
+                                   zip(got[rid].logits, ref[rid].logits)))
+    out["coldstart_numerics_ok"] = numerics_ok
+    cold_wall = out["coldstart_cold_grant_to_first_token_ms"]
+    hit_wall = out["coldstart_hit_grant_to_first_token_ms"]
+    sb_wall = out["coldstart_standby_grant_to_first_token_ms"]
+    out["coldstart_hit_speedup_wall"] = (
+        round(cold_wall / hit_wall, 2) if hit_wall else None)
+    out["coldstart_standby_speedup_wall"] = (
+        round(cold_wall / sb_wall, 2) if sb_wall else None)
+    shutil.rmtree(root, ignore_errors=True)
+    if not on_tpu:
+        out["coldstart_sim_note"] = (
+            "CPU simulation: XLA-CPU compiles the tiny 2-layer step in "
+            "tens of milliseconds where XLA-TPU spends seconds-to-"
+            "minutes on a real model, so the wall split UNDERSTATES "
+            "the cold-start win; params are handed over in memory, so "
+            "the checkpoint-restore segment of a real grant (priced by "
+            "the ckpt bench, ROOFLINE §7) is absent from every leg. "
+            "The claims that transfer: the cache state machine (cold "
+            "populates, hit deserializes), "
+            "coldstart_hit_zero_fresh_compiles (a cache-hit start "
+            "traces and compiles NOTHING — the counter pin), the "
+            "standby grant collapsing to promote + first request, and "
+            "coldstart_numerics_ok (bitwise identical streams, logits "
+            "included). ROOFLINE §13 prices the metal version")
+    return out

@@ -94,6 +94,11 @@ _LAZY = {
     "restore_pytree": "restore", "find_path_prefix": "restore",
     "AsyncCheckpointer": "snapshot", "Snapshot": "snapshot",
     "extract_snapshot": "snapshot", "write_snapshot": "snapshot",
+    # AOT compile cache (tony_tpu.ckpt.aot): jax-free at import like
+    # format, but re-exported lazily by the same rule — the cache's
+    # fingerprint helpers import jax on first use.
+    "AOTCache": "aot", "make_fingerprint": "aot",
+    "fingerprint_key": "aot",
 }
 
 __all__ = [

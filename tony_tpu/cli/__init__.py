@@ -174,6 +174,30 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.prefix_store:
         cfg.set(conf_mod.SERVE_PREFIX_STORE,
                 str(Path(args.prefix_store).resolve()))
+    # Replica cold-start plane (tony_tpu.ckpt.aot PR 17): persisted AOT
+    # executables + warm-standby pool + the demotion daemon watermark.
+    # Same submit-time validation story: the engine rejects a bad
+    # watermark at launch, replica by replica; the cache dir must be
+    # absolute for the same cwd reason as the prefix store.
+    if args.aot_cache:
+        cfg.set(conf_mod.SERVE_AOT_CACHE,
+                str(Path(args.aot_cache).resolve()))
+    if args.warm_standby < 0:
+        raise SystemExit(f"--warm_standby must be >= 0, got "
+                         f"{args.warm_standby}")
+    if args.warm_standby:
+        cfg.set(conf_mod.SERVE_WARM_STANDBY, str(args.warm_standby))
+    if not 0.0 <= args.demote_watermark <= 1.0:
+        raise SystemExit(f"--demote_watermark must be a pool fraction "
+                         f"in [0, 1], got {args.demote_watermark}")
+    if args.demote_watermark and not args.host_blocks:
+        raise SystemExit("--demote_watermark needs --host_blocks > 0 "
+                         "(the daemon demotes into the host tier; "
+                         "without one the flag would be silently "
+                         "ignored)")
+    if args.demote_watermark:
+        cfg.set(conf_mod.SERVE_DEMOTE_WATERMARK,
+                str(args.demote_watermark))
     if args.prefix_cache:
         cfg.set(conf_mod.SERVE_PREFIX_CACHE, "true")
     if args.prefill_chunk:
@@ -465,6 +489,27 @@ def make_parser() -> argparse.ArgumentParser:
                          "ckpt plane's atomic rename, and fresh or "
                          "scale-up replicas warm their prefix tier "
                          "from the store on start")
+    sv.add_argument("--aot_cache", default=None, metavar="DIR",
+                    help="persisted AOT compile cache directory: step "
+                         "executables compiled once serialize next to "
+                         "the ckpt manifest, and every later replica "
+                         "of the same (topology, config, jax) family "
+                         "deserializes in milliseconds instead of "
+                         "re-tracing — the scale-up grant's cold-start "
+                         "killer")
+    sv.add_argument("--warm_standby", type=int, default=0,
+                    help="warm-standby pool size per serve jobtype "
+                         "(0 = off): compiled-and-idle replicas held "
+                         "ahead of the traffic curve; the AM promotes "
+                         "one on scale-up instead of a cold grant "
+                         "(per-gang override: "
+                         "tony.serve.warm-standby.<jobtype>)")
+    sv.add_argument("--demote_watermark", type=float, default=0.0,
+                    help="device-pool occupancy fraction above which "
+                         "the engine loop pre-demotes cold KV blocks "
+                         "into the --host_blocks tier (0 = off): "
+                         "eviction pressure is drained ahead of the "
+                         "work arriving, like the warm pool itself")
     sv.add_argument("--spec_k", type=int, default=0,
                     help="speculative decoding draft depth (0 = off; "
                          "k tokens drafted, verified in ONE target "

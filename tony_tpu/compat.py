@@ -49,3 +49,30 @@ def mesh_context(mesh) -> Any:
     if hasattr(jax, "set_mesh"):
         return jax.set_mesh(mesh)
     return contextlib.nullcontext()
+
+
+def serialize_compiled(compiled) -> Any:
+    """``(payload_bytes, in_tree, out_tree)`` of a ``jax.stages.Compiled``
+    via ``jax.experimental.serialize_executable`` — the AOT compile
+    cache's wire (:mod:`tony_tpu.ckpt.aot`). Returns ``None`` when this
+    jax/backend cannot serialize executables (older 0.4.x lines, or a
+    PJRT plugin without executable serialization): the cache degrades to
+    a counted miss, never a wrong program."""
+    try:
+        from jax.experimental import serialize_executable as _se
+        return _se.serialize(compiled)
+    except Exception:
+        return None
+
+
+def deserialize_compiled(payload: bytes, in_tree, out_tree) -> Any:
+    """Load a serialized executable back into a callable
+    ``jax.stages.Compiled`` — the other half of
+    :func:`serialize_compiled`. ``None`` on ANY failure (version skew,
+    plugin mismatch, torn payload): callers re-trace instead — a cold
+    start may cost a compile, never a wrong program."""
+    try:
+        from jax.experimental import serialize_executable as _se
+        return _se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        return None

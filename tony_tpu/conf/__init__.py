@@ -155,6 +155,17 @@ SERVE_ROLE_PREFIX = "tony.serve.role."
 # fresh replica warms its prefix tier from disk instead of recompute.
 SERVE_HOST_BLOCKS = "tony.serve.host-blocks"    # host tier size (0 = off)
 SERVE_PREFIX_STORE = "tony.serve.prefix-store"  # stem store dir ("" = off)
+# Replica cold-start plane (PR 17): the AOT cache dir persists compiled
+# step executables next to the ckpt manifest (tony_tpu.ckpt.aot) so a
+# scale-up grant deserializes instead of re-tracing; warm-standby > 0
+# holds that many compiled-and-idle replicas per serve jobtype ahead of
+# the traffic curve (the AM promotes one on scale-up instead of a cold
+# grant); the demote watermark arms the engine-loop demotion daemon
+# that pre-drains the device pool into the PR 16 host tier.
+SERVE_AOT_CACHE = "tony.serve.aot-cache"        # AOT cache dir ("" = off)
+SERVE_WARM_STANDBY = "tony.serve.warm-standby"  # standby pool size (0=off)
+SERVE_DEMOTE_WATERMARK = "tony.serve.demote-watermark"  # pool frac (0=off)
+SERVE_DEMOTE_BATCH = "tony.serve.demote-batch"  # blocks/sweep (0=nb_max)
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)
@@ -192,6 +203,15 @@ def serve_replicas_max_key(job_type: str) -> str:
     apportions across the serve jobtypes (scaling.apportion_fleet_max)
     — two gangs must not each inflate to the whole budget."""
     return f"{SERVE_REPLICAS_MAX}.{job_type}"
+
+def serve_warm_standby_key(job_type: str) -> str:
+    """Per-jobtype warm-standby pool override for a split fleet:
+    ``tony.serve.warm-standby.<jobtype>``. Without it the global
+    ``tony.serve.warm-standby`` applies to every serve jobtype —
+    a prefill gang and a decode gang usually want different pools
+    (prefill compiles one chunk program; decode compiles a bucket
+    ladder), so the per-gang key mirrors the replicas.max override."""
+    return f"{SERVE_WARM_STANDBY}.{job_type}"
 
 def env_key(job_type: str) -> str:
     return f"tony.{job_type}.env"           # csv KEY=VALUE extra env

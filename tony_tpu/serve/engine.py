@@ -198,7 +198,8 @@ class PagedModelRunner:
                     decode_buckets: Sequence[int], max_running: int,
                     n_blocks: Optional[int], mesh: Optional[Any],
                     host_blocks: int = 0,
-                    async_offload: bool = False) -> None:
+                    async_offload: bool = False,
+                    aot_cache: Optional[Any] = None) -> None:
         cfg = model.cfg
         if q_block % 8:
             raise ValueError(f"q_block must be a sublane-tile multiple "
@@ -225,6 +226,20 @@ class PagedModelRunner:
                                   host_blocks=host_blocks,
                                   async_offload=async_offload)
         self._fns: Dict[Tuple[int, int], Callable] = {}
+        # AOT compile cache (tony_tpu.ckpt.aot — the replica cold-start
+        # plane): executables resolved through the cache live in a
+        # PARALLEL dict so the raw jitted Wrapped in _fns stays what the
+        # analysis hooks (decode_traced / prefill_traced) trace — the
+        # cache must never change the traced program, only who compiles
+        # it. With no cache and no warm(), _aot_fns stays empty and the
+        # launch path is byte-for-byte the raw jit.
+        self.aot_cache = aot_cache
+        self._aot_fns: Dict[Tuple[int, int], Callable] = {}
+        self.aot_hits = 0
+        self.aot_misses = 0
+        self.fresh_compiles = 0
+        self.compile_ms = 0.0
+        self.deserialize_ms = 0.0
         # Forward-launch counter (prefills + decode/verify steps): the
         # machine-independent cost of a schedule — on an accelerator the
         # forward dominates wall time, so fewer launches for the same
@@ -244,8 +259,110 @@ class PagedModelRunner:
                 kv_dim=self.kv_dim, ctx_pad=self.ctx_pad, b=b, t=t)
         return self._fns[key]
 
+    def _example_args(self, b: int, t: int) -> Tuple:
+        """Shape-exact example arguments of the (b, t) step — the ONE
+        aval source for lowering (:meth:`_compile_step`) and the
+        analysis hooks (:meth:`ServeEngine.decode_traced` /
+        :meth:`ServeEngine.prefill_traced`), so what the AOT path
+        compiles can never drift from what the analyzer audits."""
+        return (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((b, t), jnp.int32),
+                jnp.zeros((b, t), jnp.int32),
+                jnp.zeros((b, self.nb_max), jnp.int32),
+                jnp.full((b, t), self.cache.oob_index, jnp.int32))
+
+    def _aot_fingerprint(self, b: int, t: int) -> Dict[str, Any]:
+        """The (b, t) step program's cache identity: mesh topology, the
+        full build_step_fn geometry, the model config, and the
+        params/pool aval digest — plus the jax/jaxlib/XLA runtime half
+        make_fingerprint adds. Anything here drifting is a MISS."""
+        from tony_tpu.ckpt import aot
+        cfg = getattr(self.model, "cfg", None)
+        return aot.make_fingerprint(
+            "serve_step", mesh=self.mesh,
+            geometry={"n_layers": self.n_layers,
+                      "n_blocks": self.cache.n_blocks,
+                      "block_size": self.block_size,
+                      "kv_dim": self.kv_dim, "ctx_pad": self.ctx_pad,
+                      "b": int(b), "t": int(t), "donate": [1, 2]},
+            model=f"{type(self.model).__name__}:{cfg!r}",
+            tree=(self.params, self.cache.k, self.cache.v))
+
+    def _compile_step(self, b: int, t: int) -> Callable:
+        """Lower + compile the (b, t) program ahead of time (counted in
+        ``fresh_compiles``/``compile_ms``) — the same jitted function
+        the default path runs, so the resulting executable is the
+        IDENTICAL program, just compiled now instead of at first
+        launch."""
+        t0 = time.monotonic()
+        jitted = self._fn(b, t)
+        args = self._example_args(b, t)
+        if self.mesh is not None:
+            with mesh_context(self.mesh):
+                compiled = jitted.lower(*args).compile()
+        else:
+            compiled = jitted.lower(*args).compile()
+        self.compile_ms += 1e3 * (time.monotonic() - t0)
+        self.fresh_compiles += 1
+        return compiled
+
+    def _resolve_aot(self, b: int, t: int) -> Callable:
+        """One (b, t) executable through the AOT cache: deserialize on
+        hit (milliseconds), trace+compile AND populate on miss. The
+        cache degrades to a counted miss on any corruption, fingerprint
+        drift, or unsupported backend — it may cost a compile, never a
+        wrong program."""
+        fp = self._aot_fingerprint(b, t)
+        t0 = time.monotonic()
+        compiled = self.aot_cache.get(fp)
+        if compiled is not None:
+            self.deserialize_ms += 1e3 * (time.monotonic() - t0)
+            self.aot_hits += 1
+            return compiled
+        self.aot_misses += 1
+        compiled = self._compile_step(b, t)
+        self.aot_cache.put(fp, compiled)
+        return compiled
+
+    def _step_callable(self, b: int, t: int) -> Callable:
+        """What :meth:`_run_fn` launches for shape (b, t): the raw
+        jitted Wrapped when nothing armed the AOT plane (the default
+        engine, byte for byte), else the resolved Compiled — from the
+        cache on hit, freshly compiled (and persisted) on miss."""
+        fn = self._aot_fns.get((b, t))
+        if fn is None:
+            if self.aot_cache is None:
+                return self._fn(b, t)
+            fn = self._resolve_aot(b, t)
+            self._aot_fns[(b, t)] = fn
+        return fn
+
+    def warm(self, prefill_pads: Sequence[int] = ()) -> int:
+        """Resolve the engine's enumerable step family NOW — every
+        decode bucket (the speculative verify launch rides the same
+        shapes), the chunked-prefill program, and any caller-named
+        extra prefill pads — so a warm-standby replica holds compiled
+        executables BEFORE its first request: cache hits deserialize in
+        milliseconds; cold misses pay the trace+compile here, ahead of
+        the traffic curve, and populate the cache for the whole fleet.
+        Returns programs resolved."""
+        shapes = [(int(b), self.q_block) for b in self.decode_buckets]
+        chunk = getattr(self, "prefill_chunk", None)
+        if chunk:
+            shapes.append((1, int(chunk)))
+        for p in prefill_pads:
+            shapes.append((1, int(p)))
+        n = 0
+        for key in dict.fromkeys(shapes):
+            if key not in self._aot_fns:
+                self._aot_fns[key] = (
+                    self._resolve_aot(*key) if self.aot_cache is not None
+                    else self._compile_step(*key))
+                n += 1
+        return n
+
     def _run_fn(self, b, t, tokens, positions, tables, flat_idx):
-        fn = self._fn(b, t)
+        fn = self._step_callable(b, t)
         args = (self.params, self.cache.k, self.cache.v,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(tables), jnp.asarray(flat_idx))
@@ -279,19 +396,26 @@ class ServeEngine(PagedModelRunner):
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
                  role: str = "colocated", host_blocks: int = 0,
-                 async_offload: bool = False):
+                 async_offload: bool = False,
+                 aot_cache: Optional[Any] = None,
+                 warm_standby: bool = False,
+                 demote_watermark: float = 0.0, demote_batch: int = 0):
         if join_policy not in ("continuous", "static"):
             raise ValueError(f"unknown join_policy {join_policy!r} "
                              "(continuous|static)")
         if role not in ("colocated", "prefill", "decode"):
             raise ValueError(f"unknown role {role!r} "
                              "(colocated|prefill|decode)")
+        if not 0.0 <= float(demote_watermark) <= 1.0:
+            raise ValueError(f"demote_watermark must be a pool fraction "
+                             f"in [0, 1], got {demote_watermark}")
         self._init_paged(model, params, ctx_max=ctx_max,
                          block_size=block_size, q_block=q_block,
                          decode_buckets=decode_buckets,
                          max_running=max_running, n_blocks=n_blocks,
                          mesh=mesh, host_blocks=host_blocks,
-                         async_offload=async_offload)
+                         async_offload=async_offload,
+                         aot_cache=aot_cache)
         # Prefix caching (off by default — the unrouted PR 10/12
         # behavior): admission chain-hashes the prompt's full blocks and
         # adopts published matches instead of recomputing them. Bitwise
@@ -330,6 +454,23 @@ class ServeEngine(PagedModelRunner):
         # conversation handle -> {"tokens": full parked token history,
         # "rid": the parked cache record's id}.
         self.host_offload = host_blocks > 0
+        # Warm-standby membership (the cold-start plane's pool half):
+        # a standby replica is compiled-and-idle — it heartbeats
+        # warm_standby=1 so the session keeps it out of the routable
+        # endpoint set and the autoscaler's active count, until the AM
+        # promotes it (rpc_promote -> engine.promote()) on a scale-up
+        # instead of paying a cold grant.
+        self.warm_standby = bool(warm_standby)
+        # Demotion daemon (ROADMAP KV follow-on; OFF by default): at
+        # the high watermark the engine loop demotes a batch of cold
+        # cached-tier blocks to host RAM ahead of pool pressure — the
+        # same "be ready before the work arrives" story as the warm
+        # pool. Batch default nb_max: one context extent per sweep,
+        # the ROOFLINE §12 link unit (a demotion is one batched
+        # device->host fetch, so the batch sizes the PCIe transfer).
+        self.demote_watermark = float(demote_watermark)
+        self.demote_batch = int(demote_batch) or self.nb_max
+        self.daemon_demotions = 0
         self._parked: Dict[Any, Dict[str, Any]] = {}
         self.park_hits = 0
         self.park_lookups = 0
@@ -1117,6 +1258,18 @@ class ServeEngine(PagedModelRunner):
                 else:
                     still.append(s)
             self._running = still
+        # Demotion daemon (off unless a watermark armed it): above the
+        # high watermark, demote one batch of cold cached-tier blocks
+        # to host RAM — freeing device blocks BEFORE the next admission
+        # needs them, at batch granularity so the device->host fetch
+        # amortizes the link (ROOFLINE §12). demote() only ever takes
+        # refcount-0 published blocks off the ref-aware LRU, so a live
+        # sequence can never lose KV to the daemon.
+        if self.demote_watermark > 0.0 and self.host_offload:
+            used = self.cache.n_blocks - self.cache.free_blocks
+            if used >= self.demote_watermark * self.cache.n_blocks:
+                self.daemon_demotions += self.cache.demote(
+                    self.demote_batch)
         self._steps += 1
         return results
 
@@ -1241,6 +1394,17 @@ class ServeEngine(PagedModelRunner):
             "promotions": float(self.cache.promoted_total),
             "park_hit_rate": (self.park_hits / self.park_lookups
                               if self.park_lookups else 0.0),
+            # Cold-start plane telemetry (PR 17): zeros on engines
+            # without the AOT cache / warm pool, same uniform-schema
+            # rule as every widening above. warm_standby rides the
+            # heartbeat so the session excludes standbys from routing
+            # and the autoscaler from the active count until the AM
+            # promotes them.
+            "aot_hits": float(self.aot_hits),
+            "aot_misses": float(self.aot_misses),
+            "compile_ms": float(self.compile_ms),
+            "warm_standby": 1.0 if self.warm_standby else 0.0,
+            "daemon_demotions": float(self.daemon_demotions),
         }
         stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
@@ -1290,20 +1454,32 @@ class ServeEngine(PagedModelRunner):
             json.dump(payload, fh)
         os.replace(tmp, path)
 
+    # -- warm-standby promotion (tony_tpu.serve.scaling) -------------------
+    def promote(self) -> bool:
+        """Leave warm standby: the AM's scale-up path calls this (over
+        the replica's ``promote`` RPC verb) instead of cold-granting a
+        container — the next stats publish advertises warm_standby=0
+        and the session adds the replica to the routable endpoint set.
+        Returns whether the engine WAS a standby (idempotent: promoting
+        an active replica is a no-op, so a duplicated RPC is
+        harmless)."""
+        with self._lock:
+            was = self.warm_standby
+            self.warm_standby = False
+        return was
+
     # -- static-analysis hook ---------------------------------------------
     def decode_traced(self, batch: Optional[int] = None):
         """``(jitted, example_args)`` of the canonical decode bucket for
         :func:`tony_tpu.analysis.analyze_serve_step` — the same jit the
-        loop runs, traced, never executed."""
+        loop runs, traced, never executed. Always the raw jitted
+        Wrapped from ``_fns`` — the AOT cache resolves executables in a
+        parallel dict precisely so this hook (and its signature pin)
+        cannot drift when the cache is armed."""
         b = _bucket_of(self.decode_buckets,
                        batch if batch is not None else 1)
-        t = self.q_block
-        args = (self.params, self.cache.k, self.cache.v,
-                jnp.zeros((b, t), jnp.int32),
-                jnp.zeros((b, t), jnp.int32),
-                jnp.zeros((b, self.nb_max), jnp.int32),
-                jnp.full((b, t), self.cache.oob_index, jnp.int32))
-        return self._fn(b, t), args
+        return self._fn(b, self.q_block), \
+            self._example_args(b, self.q_block)
 
     def prefill_traced(self):
         """``(jitted, example_args)`` of the canonical prefill-chunk
@@ -1315,12 +1491,7 @@ class ServeEngine(PagedModelRunner):
         chunk geometry is the ONLY compiled prefill shape the feature
         declares."""
         t = int(self.prefill_chunk or self.q_block)
-        args = (self.params, self.cache.k, self.cache.v,
-                jnp.zeros((1, t), jnp.int32),
-                jnp.zeros((1, t), jnp.int32),
-                jnp.zeros((1, self.nb_max), jnp.int32),
-                jnp.full((1, t), self.cache.oob_index, jnp.int32))
-        return self._fn(1, t), args
+        return self._fn(1, t), self._example_args(1, t)
 
 
 class EngineFront:

@@ -34,15 +34,18 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
-from tony_tpu.conf import (CKPT_DIR, SERVE_BLOCK_SIZE, SERVE_CKPT_DIR,
-                           SERVE_CTX_MAX, SERVE_DRAFT_CKPT_DIR,
-                           SERVE_DRAFT_MODEL, SERVE_DRAFT_MODEL_KWARGS,
+from tony_tpu.conf import (CKPT_DIR, SERVE_AOT_CACHE, SERVE_BLOCK_SIZE,
+                           SERVE_CKPT_DIR, SERVE_CTX_MAX,
+                           SERVE_DEMOTE_BATCH, SERVE_DEMOTE_WATERMARK,
+                           SERVE_DRAFT_CKPT_DIR, SERVE_DRAFT_MODEL,
+                           SERVE_DRAFT_MODEL_KWARGS,
                            SERVE_DRAFT_NGRAM_MAX, SERVE_DTYPE_POLICY,
                            SERVE_HOST_BLOCKS, SERVE_MAX_RUNNING,
                            SERVE_MESH, SERVE_MODEL, SERVE_MODEL_KWARGS,
                            SERVE_PORT, SERVE_PREFILL_CHUNK,
                            SERVE_PREFIX_CACHE, SERVE_PREFIX_STORE,
-                           SERVE_SPEC_K, serve_role_key)
+                           SERVE_SPEC_K, SERVE_WARM_STANDBY,
+                           serve_role_key, serve_warm_standby_key)
 from tony_tpu.serve.engine import Completion, EngineFront, ServeEngine
 
 
@@ -64,11 +67,24 @@ class Replica:
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
                  role: str = "colocated", host_blocks: int = 0,
-                 prefix_store: Optional[str] = None):
+                 prefix_store: Optional[str] = None,
+                 aot_cache: Optional[str] = None,
+                 warm_standby: bool = False,
+                 demote_watermark: float = 0.0,
+                 demote_batch: int = 0):
         from tony_tpu._trace import trace_record
         from tony_tpu.models import get_model
         from tony_tpu.serve.disagg import DecodeFront, PrefillFront
 
+        # Cold-start plane (tony_tpu.ckpt.aot): a cache DIR in the conf
+        # becomes a live AOTCache shared by every step family the
+        # engine compiles. Built before the engine so the very first
+        # bucket resolution can hit.
+        self._aot = None
+        if aot_cache:
+            from tony_tpu.ckpt import AOTCache
+
+            self._aot = AOTCache(aot_cache)
         self.model = get_model(model_name, **(model_kwargs or {}))
         self.mesh = mesh
         params, step, prefix = self._restore_params(
@@ -99,7 +115,10 @@ class Replica:
                 keep_logits=keep_logits, tag=tag,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                 role=role, host_blocks=host_blocks,
-                async_offload=host_blocks > 0, **draft_kw)
+                async_offload=host_blocks > 0, aot_cache=self._aot,
+                warm_standby=warm_standby,
+                demote_watermark=demote_watermark,
+                demote_batch=demote_batch, **draft_kw)
         else:
             self.engine = ServeEngine(
                 self.model, params, ctx_max=ctx_max,
@@ -108,7 +127,10 @@ class Replica:
                 keep_logits=keep_logits, tag=tag,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                 role=role, host_blocks=host_blocks,
-                async_offload=host_blocks > 0)
+                async_offload=host_blocks > 0, aot_cache=self._aot,
+                warm_standby=warm_standby,
+                demote_watermark=demote_watermark,
+                demote_batch=demote_batch)
         trace_record("serve", "replica", model=model_name,
                      ckpt_step=step, path_prefix=prefix,
                      dtype_policy=dtype_policy, spec_k=int(spec_k),
@@ -136,6 +158,18 @@ class Replica:
 
             self._store = PrefixStore(prefix_store)
             self._load_stems()
+        # Pre-resolve the step family when the cold-start plane is on:
+        # a warm STANDBY must hold executables before promotion (that
+        # is the whole point of the pool), and a cache-armed active
+        # replica resolves now so its first request pays deserialize
+        # milliseconds — and its misses populate the cache for every
+        # later grant of the family.
+        if self._aot is not None or warm_standby:
+            n = self.engine.warm()
+            print(f"[tony-serve-replica] warmed {n} step program(s) "
+                  f"(aot hits {self.engine.aot_hits}, "
+                  f"misses {self.engine.aot_misses})", flush=True)
+        self._publish: Optional[Any] = None
         self.port: Optional[int] = None
 
     def _load_stems(self) -> None:
@@ -222,6 +256,17 @@ class Replica:
     def kv_import(self, payload: Dict[str, Any]) -> Completion:
         return self._decode_front.kv_import(payload)
 
+    # -- warm-standby promotion (tony_tpu.serve.scaling) -------------------
+    def promote(self) -> bool:
+        """AM scale-up path: leave warm standby and republish stats
+        IMMEDIATELY — the session routes on warm_standby=0, and waiting
+        a publish tick to become routable would hand back the very
+        cold-start latency the pool exists to hide."""
+        was = self.engine.promote()
+        if was and self._publish is not None:
+            self._publish()
+        return was
+
     # -- RPC front ---------------------------------------------------------
     def rpc_handler(self) -> "_ReplicaRpcHandler":
         return _ReplicaRpcHandler(self)
@@ -263,6 +308,9 @@ class Replica:
                 except OSError:
                     pass
 
+        # The promote RPC republishes through this hook so a promotion
+        # is routable on the next heartbeat, not the next publish tick.
+        self._publish = publish
         try:
             # First publish BEFORE the first interval: the router can
             # only dial a replica whose rpc_port reached the AM, and a
@@ -323,6 +371,12 @@ class _ReplicaRpcHandler:
     def rpc_kv_import(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         return self._wire(self.replica.kv_import(payload))
 
+    def rpc_promote(self) -> bool:
+        """The AM's scale-up verb against a warm standby (idempotent —
+        a retried promotion of an already-active replica returns
+        False and changes nothing)."""
+        return self.replica.promote()
+
 
 def main() -> int:
     """``python -m tony_tpu.serve.replica`` — the serve job type's user
@@ -355,6 +409,18 @@ def main() -> int:
     # job has no role key and runs colocated.
     job_type = os.environ.get(constants.ENV_JOB_NAME) or "serve"
     role = conf.get(serve_role_key(job_type)) or "colocated"
+    # Warm-standby membership is decided HERE, by position: the AM's
+    # backfill grants elastic tasks above the jobtype's configured
+    # instance count, so an index at-or-past that count with a warm
+    # pool configured came up as a standby — it precompiles, donates
+    # prefix stems, and waits for the promote RPC. The base gang
+    # (index < instances) always starts active.
+    warm_conf = conf.get(serve_warm_standby_key(job_type))
+    if warm_conf is None:
+        warm_conf = conf.get(SERVE_WARM_STANDBY)
+    warm_pool = int(warm_conf or 0)
+    task_index = int(os.environ.get(constants.ENV_TASK_INDEX) or 0)
+    warm_standby = warm_pool > 0 and task_index >= conf.instances(job_type)
     replica = Replica(
         model_name=model_name,
         model_kwargs=json.loads(conf.get(SERVE_MODEL_KWARGS) or "{}"),
@@ -374,7 +440,11 @@ def main() -> int:
         prefill_chunk=conf.get_int(SERVE_PREFILL_CHUNK, 0) or None,
         role=role,
         host_blocks=conf.get_int(SERVE_HOST_BLOCKS, 0),
-        prefix_store=conf.get(SERVE_PREFIX_STORE) or None)
+        prefix_store=conf.get(SERVE_PREFIX_STORE) or None,
+        aot_cache=conf.get(SERVE_AOT_CACHE) or None,
+        warm_standby=warm_standby,
+        demote_watermark=float(conf.get(SERVE_DEMOTE_WATERMARK) or 0.0),
+        demote_batch=conf.get_int(SERVE_DEMOTE_BATCH, 0))
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

@@ -151,3 +151,21 @@ def decide(policy: ScalingPolicy, n_running: int,
     if cold and n_running > policy.min_replicas:
         return -1
     return 0
+
+
+def decide_warm(policy: ScalingPolicy, warm_target: int, n_active: int,
+                n_warm: int) -> int:
+    """Warm-standby pool delta for one serve job type: how many
+    compiled-and-idle replicas to grant (+N) or retire (−N) so the pool
+    sits at ``warm_target`` — capped so active + warm never exceeds the
+    policy ceiling (a full fleet holds NO standbys: every grant the
+    budget allows is serving traffic; as ``decide`` scales the active
+    set back down, headroom reopens and the pool refills).
+
+    Runs AFTER :func:`decide`'s verdict is applied — the active count
+    it sees already includes this tick's promotion, so the pool backfill
+    and the scale-up never race for the same budget slot. Pure, like
+    ``decide``: the AM owns the clock and the grants."""
+    want = max(0, min(int(warm_target),
+                      policy.max_replicas - int(n_active)))
+    return want - int(n_warm)

@@ -348,13 +348,19 @@ class TonySession:
         along so the router retires them. ``job_type=None`` (the
         default since the disaggregated split) spans every serve-role
         jobtype, so one poll wires the router to the prefill AND decode
-        gangs; a named jobtype scopes to it."""
+        gangs; a named jobtype scopes to it. Live warm STANDBYS
+        (heartbeating ``warm_standby`` — the cold-start plane's
+        compiled-and-idle pool) are excluded: a standby is capacity,
+        not an endpoint, until the AM promotes it; its terminal entry
+        still rides along so the router retires it."""
         jts = [job_type] if job_type is not None \
             else self.serve_job_types()
         with self.lock:
             return [t.to_info() for t in self._tasks.values()
                     if t.job_type in jts
-                    and (t.serve_metrics or t.status.is_terminal)]
+                    and (t.serve_metrics or t.status.is_terminal)
+                    and not (t.serve_metrics.get("warm_standby")
+                             and not t.status.is_terminal)]
 
     def last_committed_step(self) -> Optional[int]:
         """Newest checkpoint step any executor has reported committed —

@@ -227,7 +227,8 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                           donate: bool = True,
                           apply_kwargs_of: Optional[Callable[
                               [Dict[str, jax.Array]],
-                              Dict[str, Any]]] = None):
+                              Dict[str, Any]]] = None,
+                          aot_cache: Optional[Any] = None):
     """Microbatched-accumulation train step with bucketed gradient sync —
     the comm/compute-overlap counterpart of :func:`make_train_step`.
 
@@ -371,6 +372,66 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                 state.params, mesh))
         return jitted[key]
 
+    # Cold-start plane (tony_tpu.ckpt.aot): the persisted-executable
+    # memo parallel to `jitted` — the raw jit stays what `inspect`
+    # hands the analysis plane, the compiled executable is what the
+    # hot loop calls. Keyed by (layout key, batch aval key); the CACHE
+    # key is the digest of the LOWERED module: the training step closes
+    # over an arbitrary user loss_of, which no config fingerprint can
+    # soundly capture — so this path traces always (cheap, and what a
+    # gang restart pays anyway) and skips only XLA compilation (the
+    # dominant cost). A changed loss body, flag, or topology changes
+    # the lowered text and misses cleanly.
+    compiled: Dict[Any, Any] = {}
+
+    def _compiled_for(state, batch):
+        import hashlib
+
+        from tony_tpu.ckpt import aot
+
+        fn = _jitted_for(state)
+        pleaves, ptreedef = jax.tree.flatten(state.params)
+        bleaves, btreedef = jax.tree.flatten(batch)
+        # The memo must key on EVERY state leaf's sharding, not just
+        # the params': step 1's output re-shards the optimizer state
+        # (replicated init -> the step's out_shardings), and a stale
+        # Compiled hard-fails on the mismatch where raw jit would
+        # silently re-trace. The wider key re-lowers, the lowered-HLO
+        # digest shifts, and the cache misses cleanly into a compile.
+        key = ((ptreedef,
+                tuple(getattr(l, "sharding", None)
+                      for l in jax.tree.leaves(state))),
+               (btreedef,
+                tuple((tuple(l.shape), str(l.dtype),
+                       str(getattr(l, "sharding", None)))
+                      for l in bleaves)))
+        if key in compiled:
+            return compiled[key]
+        low = fn.lower(state, batch)
+        fp = aot.make_fingerprint(
+            "train_step", mesh=mesh,
+            geometry={"microbatches": int(microbatches),
+                      "bucket_bytes": int(bucket_bytes),
+                      "reduce_op": reduce_op, "hierarchy": hierarchy,
+                      "gather": gather, "prefetch": int(prefetch),
+                      "update": update, "quant": bool(quant),
+                      "donate": bool(donate)},
+            tree=state, batch=batch,
+            extra={"hlo": hashlib.sha256(
+                low.as_text().encode()).hexdigest()})
+        # The state treedef's static aux (the optax tx) doesn't pickle,
+        # so the entry stores no call trees; both sides of the call are
+        # re-derived here, from THIS process's args and lowering.
+        ex = aot_cache.get(
+            fp,
+            in_tree=jax.tree_util.tree_structure(((state, batch), {})),
+            out_tree=jax.tree_util.tree_structure(low.out_info))
+        if ex is None:
+            ex = low.compile()
+            aot_cache.put(fp, ex)
+        compiled[key] = ex
+        return ex
+
     def stepper(state, batch):
         if update == "fused_bucket":
             from tony_tpu.ops import fused_optim
@@ -405,6 +466,8 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
                     f"different bucket plan; rebuild with "
                     f"with_gather_quant(bucket_bytes={bb})")
         with mesh_context(mesh):
+            if aot_cache is not None:
+                return _compiled_for(state, batch)(state, batch)
             return _jitted_for(state)(state, batch)
 
     def inspect(state):
