@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -145,6 +145,16 @@ tier1-kvtier:
 # timeout, but this named leg is the lane's full gate (slow included).
 tier1-aot:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m aot -p no:cacheprovider -p no:xdist -p no:randomly
+
+# History-plane + multi-tenant QoS marker leg (tony_tpu.serve.qos
+# PR 18) — weighted-fair budgets + tenant-isolation bitwise pins, the
+# widened jhist vocabulary with bounded rotation and the rename-race
+# fix, SLO-mode autoscaling + exact decision replay, the tony history
+# conf fix + dashboards; the engine-compile isolation pins and the
+# threaded reader race are slow-marked to keep tier1-verify inside its
+# timeout, but this named leg is the lane's full gate (slow included).
+tier1-qos:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m qos -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Source lints, machine-checked: (1) the jnp.concatenate/stack pack-site
 # lint (the jax-0.4 GSPMD concat-reshard footgun) — every call site

@@ -327,6 +327,22 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["coldstart_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+
+    if os.environ.get("BENCH_QOS", "1") != "0":
+        # Multi-tenant QoS leg (tony_tpu.serve.qos, PR 18): a victim
+        # tenant's decode floor absorbing an aggressor tenant's
+        # long-prompt burst, weighted-fair block budgets on vs off —
+        # victim p99 under the burst is the headline; the machine-
+        # independent claims are the deferral ledger (back-pressure on
+        # the aggressor, zero drops, zero deferrals unbudgeted) and the
+        # bitwise victim-stream gate vs an unloaded engine. CPU wall
+        # numbers measure scheduling (qos_sim_note); BENCH_r18.
+        try:
+            from tony_tpu.benchmark import run_qos_bench
+            result.update(run_qos_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["qos_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

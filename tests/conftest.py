@@ -54,6 +54,7 @@ _TIER_BY_MODULE = {
     "test_disagg": "jit",
     "test_kvtier": "jit",
     "test_aot": "jit",
+    "test_qos": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

@@ -1,0 +1,727 @@
+"""History-plane + multi-tenant QoS legs (tony_tpu.serve.qos PR 18):
+weighted-fair KV-block budget math, the tenant-isolation pin (an
+aggressor burst leaves a victim tenant's token streams AND per-token
+logits bitwise identical to an unloaded engine, with the aggressor —
+never the victim — deferred or typed-rejected), the budgets-off path
+byte-identical to an unarmed engine, the widened jhist vocabulary
+(SERVE_WINDOW / TRAIN_STEP / self-verifying SCALE_DECISION) with
+bounded rotation and the read-side rename-race fix, the tenants
+heartbeat schema round trip, the ScalingPolicy queue-depth matrix
+pinned unchanged next to the new SLO mode, exact decision replay from
+the log, and the `tony history` conf-resolution fix + dashboards."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu import events as ev
+from tony_tpu.serve import scaling
+from tony_tpu.serve.qos import QosPolicy, parse_tenants
+
+pytestmark = pytest.mark.qos
+
+
+# ---------------------------------------------------------------------------
+# Tenant spec parsing + weighted-fair budget math (pure)
+# ---------------------------------------------------------------------------
+
+class TestParseTenants:
+    def test_weighted_and_bare_names(self):
+        assert parse_tenants("gold:3,silver:1") == {"gold": 3.0,
+                                                    "silver": 1.0}
+        assert parse_tenants("solo") == {"solo": 1.0}
+        assert parse_tenants(" a :2 , b ") == {"a": 2.0, "b": 1.0}
+
+    @pytest.mark.parametrize("spec", [
+        "", " , ", ":3", "a:0", "a:-1", "a:nan", "a:x", "a:1,a:2"])
+    def test_invalid_specs_raise(self, spec):
+        with pytest.raises(ValueError):
+            parse_tenants(spec)
+
+
+class TestQosPolicy:
+    def test_budget_is_weighted_fair_share(self):
+        p = QosPolicy(classes=parse_tenants("gold:3,silver:1"))
+        active = {"gold", "silver"}
+        assert p.budget("gold", 64, active) == 48
+        assert p.budget("silver", 64, active) == 16
+
+    def test_work_conserving_idle_tenant_redistributes(self):
+        p = QosPolicy(classes=parse_tenants("gold:3,silver:1"))
+        # silver idle: gold's denominator is its own weight — full pool.
+        assert p.budget("gold", 64, {"gold"}) == 64
+        # budget() adds the asked-for tenant to the active set itself.
+        assert p.budget("silver", 64, set()) == 64
+
+    def test_floor_of_one_block(self):
+        p = QosPolicy(classes={"big": 1000.0, "tiny": 1.0})
+        assert p.budget("tiny", 4, {"big", "tiny"}) == 1
+
+    def test_unknown_tenant_gets_default_weight(self):
+        p = QosPolicy(classes={"gold": 3.0})
+        assert p.weight("stranger") == 1.0
+        assert p.budget("stranger", 64, {"gold", "stranger"}) == 16
+
+    def test_from_conf_off_is_none(self):
+        from tony_tpu.conf import TonyConfig
+
+        assert QosPolicy.from_conf(TonyConfig()) is None
+
+    def test_from_conf_round_trip(self):
+        from tony_tpu.conf import (SERVE_QOS_MAX_QUEUE,
+                                   SERVE_QOS_TENANTS, TonyConfig)
+
+        conf = TonyConfig({SERVE_QOS_TENANTS: "gold:3,silver:1",
+                           SERVE_QOS_MAX_QUEUE: "5"})
+        p = QosPolicy.from_conf(conf)
+        assert p.classes == {"gold": 3.0, "silver": 1.0}
+        assert p.max_queue == 5
+
+    def test_invalid_policy_raises(self):
+        with pytest.raises(ValueError):
+            QosPolicy(classes={"a": -1.0})
+        with pytest.raises(ValueError):
+            QosPolicy(classes={"a": 1.0}, max_queue=-1)
+
+
+# ---------------------------------------------------------------------------
+# ScalingPolicy: queue-depth matrix pinned unchanged + SLO mode + replay
+# ---------------------------------------------------------------------------
+
+def _pol(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    return scaling.ScalingPolicy(**kw)
+
+
+class TestQueueDepthMatrixPinned:
+    """The historical queue-depth decision matrix, verbatim — arming
+    the history plane must not move a single verdict."""
+
+    def test_hot_queue_scales_up(self):
+        p = _pol(queue_high=8.0)
+        assert scaling.decide(p, 2, [{"queue_depth": 9.0}], now=100.0) == 1
+
+    def test_cold_queue_scales_down(self):
+        p = _pol(queue_low=1.0)
+        assert scaling.decide(p, 2, [{"queue_depth": 0.5}], now=100.0) == -1
+
+    def test_p99_high_water_scales_up(self):
+        p = _pol(p99_high_ms=200.0)
+        assert scaling.decide(
+            p, 2, [{"queue_depth": 0.0, "p99_ms": 500.0}], now=100.0) == 1
+
+    def test_midband_holds(self):
+        p = _pol(queue_high=8.0, queue_low=1.0)
+        assert scaling.decide(p, 2, [{"queue_depth": 4.0}], now=100.0) == 0
+
+    def test_repair_below_floor_ignores_cooldown(self):
+        p = _pol(min_replicas=2, cooldown_s=30.0)
+        assert scaling.decide(p, 0, [], now=100.0, last_action=99.0) == 2
+
+    def test_cooldown_holds(self):
+        p = _pol(cooldown_s=30.0)
+        assert scaling.decide(p, 2, [{"queue_depth": 99.0}], now=100.0,
+                              last_action=90.0) == 0
+
+    def test_no_samples_holds(self):
+        assert scaling.decide(_pol(), 2, [], now=100.0) == 0
+
+    def test_ceiling_and_floor_clamp(self):
+        p = _pol(max_replicas=2)
+        assert scaling.decide(p, 2, [{"queue_depth": 99.0}], now=100.0) == 0
+        assert scaling.decide(p, 1, [{"queue_depth": 0.0}], now=100.0) == 0
+
+
+class TestSloMode:
+    def test_p99_over_target_scales_up(self):
+        p = _pol(slo_target_ms=100.0)
+        assert scaling.decide(
+            p, 2, [{"p99_ms": 150.0, "queue_depth": 0.0}], now=100.0) == 1
+
+    def test_deep_queues_alone_do_not_scale_in_slo_mode(self):
+        # SLO mode acts on the latency promise, not raw queue depth.
+        p = _pol(slo_target_ms=100.0, queue_high=8.0)
+        assert scaling.decide(
+            p, 2, [{"p99_ms": 50.0, "queue_depth": 99.0}], now=100.0) == 0
+
+    def test_cold_needs_latency_headroom_and_idle_queue(self):
+        p = _pol(slo_target_ms=100.0, queue_low=1.0)
+        assert scaling.decide(
+            p, 2, [{"p99_ms": 20.0, "queue_depth": 0.0}], now=100.0) == -1
+        # An empty window reads p99=0 — queue depth gates the retreat.
+        assert scaling.decide(
+            p, 2, [{"p99_ms": 20.0, "queue_depth": 5.0}], now=100.0) == 0
+        # Halfway to target is not headroom.
+        assert scaling.decide(
+            p, 2, [{"p99_ms": 80.0, "queue_depth": 0.0}], now=100.0) == 0
+
+    def test_worst_replica_sets_the_verdict(self):
+        p = _pol(slo_target_ms=100.0)
+        samples = [{"p99_ms": 10.0, "queue_depth": 0.0},
+                   {"p99_ms": 300.0, "queue_depth": 0.0}]
+        assert scaling.decide(p, 2, samples, now=100.0) == 1
+
+    def test_negative_target_rejected(self):
+        with pytest.raises(ValueError):
+            _pol(slo_target_ms=-1.0)
+
+    def test_from_conf_reads_target(self):
+        from tony_tpu.conf import SERVE_SLO_TARGET_MS, TonyConfig
+
+        conf = TonyConfig({SERVE_SLO_TARGET_MS: "250",
+                           "tony.serve.replicas.max": "4"})
+        p = scaling.ScalingPolicy.from_conf(conf, 1)
+        assert p.slo_target_ms == 250.0
+
+
+class TestReplayDecisions:
+    def _record(self, policy, n_active, samples, now, last_action):
+        delta = scaling.decide(policy, n_active, samples, now=now,
+                               last_action=last_action)
+        return {"job_type": "serve", "delta": delta, "n_active": n_active,
+                "samples": samples, "now": now,
+                "last_action": last_action,
+                "policy": __import__("dataclasses").asdict(policy)}
+
+    def test_replay_reproduces_live_decisions_exactly(self):
+        p = _pol(slo_target_ms=100.0, cooldown_s=30.0)
+        recs = [
+            self._record(p, 1, [{"p99_ms": 500.1234, "queue_depth": 2.0}],
+                         17.125, None),
+            self._record(p, 2, [{"p99_ms": 3.0, "queue_depth": 0.25}],
+                         99.5, 60.0),
+            self._record(p, 2, [{"p99_ms": 5000.0, "queue_depth": 9.0}],
+                         61.0, 60.0),   # cooldown hold
+        ]
+        # The wire is JSON: the replay must survive the round trip
+        # bit-exactly (floats round-trip through json by contract).
+        recs = json.loads(json.dumps(recs))
+        verdicts = scaling.replay_decisions(recs)
+        assert [v["logged"] for v in verdicts] == [1, -1, 0]
+        assert all(v["match"] for v in verdicts)
+
+    def test_tampered_record_is_flagged_not_hidden(self):
+        p = _pol(slo_target_ms=100.0)
+        rec = self._record(p, 1, [{"p99_ms": 500.0, "queue_depth": 0.0}],
+                           10.0, None)
+        rec["delta"] = 0    # the log stopped carrying the true inputs
+        v = scaling.replay_decisions([rec])[0]
+        assert not v["match"] and v["replayed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Events plane: new vocabulary, bounded rotation, rename-race fix
+# ---------------------------------------------------------------------------
+
+class TestEventVocabulary:
+    def test_serve_window_records_stats_verbatim(self, tmp_path):
+        h = ev.EventHandler(tmp_path, "app_w")
+        stats = {"qps": 2.0, "p99_ms": 12.5, "queue_depth": 1.0,
+                 "admission_rejections": 3.0, "qos_deferrals": 1.0,
+                 "tenants": {"gold": {"p99_ms": 12.5, "qps": 1.5}}}
+        h.serve_window("serve", 0, stats)
+        h.close()
+        recs = [r for r in ev.read_events(h.finished_path)
+                if r["type"] == ev.SERVE_WINDOW]
+        assert len(recs) == 1
+        assert recs[0]["payload"]["job_type"] == "serve"
+        assert recs[0]["payload"]["stats"] == stats
+
+    def test_train_step_record(self, tmp_path):
+        h = ev.EventHandler(tmp_path, "app_t")
+        h.train_step("worker", 1, step=7, step_time_s=0.125,
+                     collective_bytes=4096.0, mfu=0.41)
+        h.close()
+        p = [r["payload"] for r in ev.read_events(h.finished_path)
+             if r["type"] == ev.TRAIN_STEP][0]
+        assert p == {"job_type": "worker", "index": 1, "step": 7,
+                     "step_time_s": 0.125, "collective_bytes": 4096.0,
+                     "mfu": 0.41}
+
+    def test_scale_decision_carries_complete_decide_input(self, tmp_path):
+        import dataclasses
+
+        pol = _pol(slo_target_ms=100.0)
+        samples = [{"p99_ms": 500.0, "queue_depth": 2.0}]
+        delta = scaling.decide(pol, 1, samples, now=10.0, last_action=None)
+        h = ev.EventHandler(tmp_path, "app_s")
+        h.scale_decision("serve", delta, 1, samples, 10.0, None,
+                         dataclasses.asdict(pol))
+        h.close()
+        payloads = [r["payload"] for r in ev.read_events(h.finished_path)
+                    if r["type"] == ev.SCALE_DECISION]
+        verdicts = scaling.replay_decisions(payloads)
+        assert verdicts == [{"job_type": "serve", "logged": 1,
+                             "replayed": 1, "match": True}]
+
+
+class TestRotation:
+    def test_log_stays_bounded_and_keeps_lifecycle(self, tmp_path):
+        h = ev.EventHandler(tmp_path, "app_r", app_name="rot",
+                            max_bytes=8192)
+        h.application_inited(1, 2)
+        import dataclasses
+        h.scale_decision("serve", 1, 1, [{"p99_ms": 1.0}], 5.0, None,
+                         dataclasses.asdict(_pol()))
+        for i in range(500):
+            h.serve_window("serve", 0, {"qps": float(i), "p99_ms": 1.0,
+                                        "pad": "x" * 64})
+        assert h.rotations > 0
+        assert h.inprogress_path.stat().st_size <= 2 * 8192
+        recs = ev.read_events(h.inprogress_path)
+        types = [r["type"] for r in recs]
+        # METADATA survives as line one (job_metadata still resolves),
+        # lifecycle + SCALE_DECISION records survive whole, and the
+        # high-rate tail keeps its NEWEST windows.
+        assert ev.job_metadata(h.inprogress_path)["app_name"] == "rot"
+        assert ev.APPLICATION_INITED in types
+        assert ev.SCALE_DECISION in types
+        windows = [r["payload"]["stats"]["qps"] for r in recs
+                   if r["type"] == ev.SERVE_WINDOW]
+        assert windows and windows[-1] == 499.0
+        assert windows == sorted(windows)
+        # The writer stays live across rotations.
+        h.application_finished("SUCCEEDED")
+        h.close()
+        assert ev.read_events(h.finished_path)[-1]["type"] == \
+            ev.APPLICATION_FINISHED
+
+
+class TestRenameRace:
+    def test_read_events_follows_finish_rename(self, tmp_path):
+        h = ev.EventHandler(tmp_path, "app_race")
+        h.application_inited(1, 1)
+        stale = Path(h.inprogress_path)
+        assert ev.read_events(stale)          # prime the parse cache
+        h.application_finished("SUCCEEDED")
+        h.close()                             # inprogress → finished
+        assert not stale.exists()
+        recs = ev.read_events(stale)          # the regression: raised
+        assert [r["type"] for r in recs][-1] == ev.APPLICATION_FINISHED
+
+    def test_job_metadata_follows_finish_rename(self, tmp_path):
+        h = ev.EventHandler(tmp_path, "app_race2", app_name="meta")
+        stale = Path(h.inprogress_path)
+        h.close()
+        assert ev.job_metadata(stale)["app_name"] == "meta"
+
+    def test_truly_missing_file_still_raises(self, tmp_path):
+        with pytest.raises(OSError):
+            ev.read_events(tmp_path / "intermediate"
+                           / "ghost.jhist.inprogress")
+
+
+class TestParseCache:
+    def test_cached_reads_are_isolated_copies(self, tmp_path):
+        h = ev.EventHandler(tmp_path, "app_c")
+        h.application_inited(1, 1)
+        h.close()
+        first = ev.read_events(h.finished_path)
+        first.append({"type": "FORGED", "timestamp": 0, "payload": {}})
+        second = ev.read_events(h.finished_path)
+        assert [r["type"] for r in second
+                if r["type"] != "METADATA"] == [ev.APPLICATION_INITED]
+
+
+@pytest.mark.slow
+class TestEventsConcurrency:
+    def test_writer_vs_concurrent_readers(self, tmp_path):
+        """One writer appending serve windows while reader threads hammer
+        read_events/list_jobs through the close() rename — every read
+        returns a clean prefix (no torn/partial records), and the
+        post-rename reads land on the finished sibling."""
+        h = ev.EventHandler(tmp_path, "app_mt")
+        h.application_inited(1, 1)
+        path = Path(h.inprogress_path)
+        stop = threading.Event()
+        failures: list = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    recs = ev.read_events(path)
+                    for r in recs:
+                        assert "type" in r and "payload" in r
+                    list(ev.list_jobs(tmp_path))
+                except Exception as e:   # noqa: BLE001 — collected
+                    failures.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=reader, name=f"qos-reader-{i}")
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(200):
+                h.serve_window("serve", 0, {"qps": float(i)})
+            h.application_finished("SUCCEEDED")
+            h.close()
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                time.sleep(0.01)        # readers race the rename window
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+        assert not failures, failures
+        recs = ev.read_events(path)     # stale path → finished sibling
+        assert recs[-1]["type"] == ev.APPLICATION_FINISHED
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat schema: tenants breakdown round trip stats-file → session
+# ---------------------------------------------------------------------------
+
+class TestTelemetrySchema:
+    STATS = {"qps": 1.5, "p99_ms": 20.0, "queue_depth": 2.0,
+             "admission_rejections": 4.0, "qos_deferrals": 1.0,
+             "tenants": {"gold": {"qps": 1.0, "p99_ms": 20.0,
+                                  "queued": 0.0, "blocks": 6.0,
+                                  "completed": 9.0,
+                                  "tokens_per_s": 12.0}}}
+
+    def test_normalize_keeps_tenants_nesting(self):
+        from tony_tpu.util import normalize_serve_telemetry
+
+        out = normalize_serve_telemetry(self.STATS)
+        assert out["tenants"]["gold"]["p99_ms"] == 20.0
+        assert isinstance(out["tenants"], dict)
+
+    def test_deeper_nesting_rejected(self):
+        from tony_tpu.util import normalize_serve_telemetry
+
+        with pytest.raises(TypeError):
+            normalize_serve_telemetry(
+                {"tenants": {"g": {"sub": {"deeper": 1.0}}}})
+
+    def test_stats_file_to_session_round_trip(self, tmp_path):
+        from tony_tpu.conf import TonyConfig
+        from tony_tpu.executor import read_serve_stats
+        from tony_tpu.session import TonySession
+
+        path = tmp_path / "stats.json"
+        tmp = tmp_path / "stats.json.tmp"
+        tmp.write_text(json.dumps(self.STATS))
+        tmp.rename(path)
+        norm = read_serve_stats(path)
+        assert norm is not None
+        s = TonySession(TonyConfig({"tony.serve.instances": "1"}),
+                        app_id="app_1_0001")
+        s.on_registered("serve", 0, "127.0.0.1", 4000)
+        s.on_heartbeat("serve", 0, serve=norm)
+        samples = s.serve_samples("serve")
+        assert len(samples) == 1
+        assert samples[0]["tenants"]["gold"]["completed"] == 9.0
+        assert samples[0]["admission_rejections"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# AM emission: heartbeat dicts → jhist with per-task dedup
+# ---------------------------------------------------------------------------
+
+class TestAmEmission:
+    def _fake_am(self, tmp_path):
+        import types
+
+        from tony_tpu.am import ApplicationMaster
+
+        fake = types.SimpleNamespace(
+            events=ev.EventHandler(tmp_path, "app_am"))
+        fake._log_history_events = types.MethodType(
+            ApplicationMaster._log_history_events, fake)
+        return fake
+
+    def _session(self):
+        from tony_tpu.conf import TonyConfig
+        from tony_tpu.session import TonySession
+
+        s = TonySession(TonyConfig({"tony.serve.instances": "1",
+                                    "tony.worker.instances": "1"}),
+                        app_id="app_1_0001")
+        for t in s.tasks():
+            s.on_registered(t.job_type, t.index, "127.0.0.1", 4000)
+        return s
+
+    def test_serve_and_train_windows_logged_with_dedup(self, tmp_path):
+        fake = self._fake_am(tmp_path)
+        s = self._session()
+        s.on_heartbeat("serve", 0, serve={"qps": 1.0, "p99_ms": 5.0})
+        s.on_heartbeat("worker", 0, serve={"step": 3.0,
+                                           "step_time_s": 0.2,
+                                           "collective_bytes": 64.0,
+                                           "mfu": 0.5})
+        fake._log_history_events(s)
+        fake._log_history_events(s)      # identical tick: appends nothing
+        s.on_heartbeat("serve", 0, serve={"qps": 2.0, "p99_ms": 6.0})
+        fake._log_history_events(s)
+        fake.events.close()
+        recs = ev.read_events(fake.events.finished_path)
+        windows = [r["payload"] for r in recs
+                   if r["type"] == ev.SERVE_WINDOW]
+        steps = [r["payload"] for r in recs if r["type"] == ev.TRAIN_STEP]
+        assert [w["stats"]["qps"] for w in windows] == [1.0, 2.0]
+        assert steps == [{"job_type": "worker", "index": 0, "step": 3,
+                          "step_time_s": 0.2, "collective_bytes": 64.0,
+                          "mfu": 0.5}]
+
+
+# ---------------------------------------------------------------------------
+# tony history: conf-resolved roots + the dashboards
+# ---------------------------------------------------------------------------
+
+class TestHistoryRoots:
+    def test_workdir_scan_honors_history_location_conf(
+            self, tmp_path, monkeypatch):
+        from tony_tpu import constants, history
+
+        workdir = tmp_path / "jobs"
+        redirect = tmp_path / "shared-history"
+        jobdir = workdir / "app_redir_0001"
+        jobdir.mkdir(parents=True)
+        (jobdir / constants.TONY_JOB_JSON).write_text(json.dumps(
+            {"tony.history.location": str(redirect)}))
+        h = ev.EventHandler(redirect, "app_redir_0001", app_name="redir")
+        h.application_finished("SUCCEEDED")
+        h.close()
+        monkeypatch.setenv("TONY_WORK_DIR", str(workdir))
+        jobs = history.gather_jobs(None)
+        assert [j["app_id"] for j in jobs] == ["app_redir_0001"]
+        # The conventional fallback still works next to it.
+        jobdir2 = workdir / "app_conv_0001"
+        h2 = ev.EventHandler(jobdir2 / "history", "app_conv_0001")
+        h2.close()
+        assert sorted(j["app_id"] for j in history.gather_jobs(None)) == [
+            "app_conv_0001", "app_redir_0001"]
+
+    def test_shared_root_not_double_listed(self, tmp_path, monkeypatch):
+        from tony_tpu import constants, history
+
+        workdir = tmp_path / "jobs"
+        shared = tmp_path / "shared"
+        for app in ("app_a_0001", "app_b_0001"):
+            jobdir = workdir / app
+            jobdir.mkdir(parents=True)
+            (jobdir / constants.TONY_JOB_JSON).write_text(json.dumps(
+                {"tony.history.location": str(shared)}))
+            h = ev.EventHandler(shared, app)
+            h.close()
+        monkeypatch.setenv("TONY_WORK_DIR", str(workdir))
+        jobs = history.gather_jobs(None)
+        assert sorted(j["app_id"] for j in jobs) == ["app_a_0001",
+                                                     "app_b_0001"]
+
+
+class TestHistoryDashboards:
+    def _job(self, tmp_path):
+        import dataclasses
+
+        from tony_tpu import history
+
+        h = ev.EventHandler(tmp_path, "app_dash_0001", app_name="dash")
+        h.application_inited(1, 2)
+        h.serve_window("serve", 0, {
+            "qps": 3.0, "p99_ms": 40.0, "queue_depth": 1.0,
+            "admission_rejections": 2.0, "qos_deferrals": 5.0,
+            "tenants": {"gold": {"qps": 2.0, "p99_ms": 40.0,
+                                 "tokens_per_s": 16.0, "queued": 1.0,
+                                 "blocks": 8.0, "completed": 11.0},
+                        "silver": {"qps": 1.0, "p99_ms": 9.0,
+                                   "tokens_per_s": 4.0, "queued": 0.0,
+                                   "blocks": 2.0, "completed": 3.0}}})
+        h.train_step("worker", 0, step=5, step_time_s=0.25,
+                     collective_bytes=1024.0, mfu=0.33)
+        pol = _pol(slo_target_ms=100.0)
+        samples = [{"p99_ms": 500.0, "queue_depth": 2.0}]
+        delta = scaling.decide(pol, 1, samples, now=10.0,
+                               last_action=None)
+        h.scale_decision("serve", delta, 1, samples, 10.0, None,
+                         dataclasses.asdict(pol))
+        h.application_finished("SUCCEEDED")
+        h.close()
+        (job,) = history.gather_jobs(tmp_path)
+        return history.job_detail(job)
+
+    def test_detail_builds_dashboards_from_the_log_alone(self, tmp_path):
+        detail = self._job(tmp_path)
+        assert detail["tenant_slo"]["gold"]["p99_ms"] == 40.0
+        assert detail["tenant_slo"]["gold"]["completed"] == 11.0
+        assert detail["tenant_slo"]["silver"]["qps"] == 1.0
+        assert detail["train_steps"]["worker:0"][0]["mfu"] == 0.33
+        assert detail["serve_windows"]["serve:0"][0][
+            "admission_rejections"] == 2.0
+        assert detail["scale_replay"] == [
+            {"job_type": "serve", "logged": 1, "replayed": 1,
+             "match": True}]
+
+    def test_render_show_and_portal_page(self, tmp_path):
+        from tony_tpu import history
+
+        detail = self._job(tmp_path)
+        text = history.render_show(detail)
+        assert "tenant SLO" in text
+        assert "gold" in text and "silver" in text
+        assert "replay exactly" in text and "1/1" in text
+        assert "mfu=0.330" in text
+        page = history._job_page(detail)
+        assert "Tenant SLO dashboard" in page
+        assert "Autoscale decisions" in page
+        assert "match" in page and "mismatch" not in page
+        assert "Train step trend" in page
+
+
+# ---------------------------------------------------------------------------
+# Engine-level QoS: budgets, back-pressure, and the isolation pins
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    import flax.linen as nn
+
+    from tony_tpu.models import get_model
+
+    model = get_model("llama-tiny", n_layers=2)
+    sample = jnp.zeros((1, 16), jnp.int32)
+    params = nn.unbox(model.init(jax.random.PRNGKey(0), sample))["params"]
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        params)
+    return model, params
+
+
+def make_engine(tiny, **kw):
+    from tony_tpu.serve import ServeEngine
+
+    model, params = tiny
+    kw.setdefault("ctx_max", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("q_block", 16)
+    kw.setdefault("decode_buckets", (2, 4))
+    kw.setdefault("max_running", 4)
+    kw.setdefault("keep_logits", True)
+    return ServeEngine(model, params, **kw)
+
+
+def _gold_silver(max_queue=0):
+    return QosPolicy(classes=parse_tenants("gold:3,silver:1"),
+                     max_queue=max_queue)
+
+
+@pytest.mark.slow
+class TestTenantIsolation:
+    def test_aggressor_burst_leaves_victim_bitwise_unchanged(self, tiny):
+        """THE acceptance pin: the victim tenant's token streams and
+        per-token logits on a QoS engine under an aggressor prefill
+        burst are bitwise identical to the same requests on an UNLOADED
+        engine — and the throttling lands on the aggressor (deferrals),
+        never the victim."""
+        from tony_tpu.serve import Request
+
+        rng = np.random.RandomState(3)
+        victims = [list(rng.randint(0, 256, n)) for n in (7, 9, 15)]
+
+        ref = make_engine(tiny)
+        for i, p in enumerate(victims):
+            ref.submit(Request(rid=f"v{i}", tokens=p, max_new_tokens=4))
+        ref_done = {c.rid: c for c in ref.run()}
+
+        qos = QosPolicy(classes={"victim": 1.0, "aggr": 1.0})
+        eng = make_engine(tiny, qos=qos)
+        # Aggressor burst FIRST: enough long prefills to swallow the
+        # whole pool were budgets off.
+        aggr = [list(rng.randint(0, 256, 30)) for _ in range(6)]
+        for i, p in enumerate(aggr):
+            eng.submit(Request(rid=f"a{i}", tokens=p, max_new_tokens=8,
+                               tenant="aggr"))
+        for i, p in enumerate(victims):
+            eng.submit(Request(rid=f"v{i}", tokens=p, max_new_tokens=4,
+                               tenant="victim"))
+        done = {c.rid: c for c in eng.run()}
+        assert sorted(done) == sorted(
+            [f"a{i}" for i in range(len(aggr))]
+            + [f"v{i}" for i in range(len(victims))])
+        for i in range(len(victims)):
+            got, want = done[f"v{i}"], ref_done[f"v{i}"]
+            assert got.tokens == want.tokens
+            assert len(got.logits) == len(want.logits)
+            for a, b in zip(got.logits, want.logits):
+                assert np.array_equal(a, b)
+        st = eng.stats()
+        # The budget deferred the aggressor at least once; the victim
+        # was never rejected (rejections need a queue cap).
+        assert st["qos_deferrals"] > 0
+        assert st["admission_rejections"] == 0.0
+        assert st["tenants"]["victim"]["completed"] == float(len(victims))
+
+    def test_queue_cap_rejects_aggressor_with_typed_backpressure(
+            self, tiny):
+        from tony_tpu.serve import AdmissionError, Request
+
+        eng = make_engine(tiny, qos=_gold_silver(max_queue=2))
+        for i in range(2):
+            eng.submit(Request(rid=f"a{i}", tokens=[1, 2, 3],
+                               max_new_tokens=2, tenant="gold"))
+        with pytest.raises(AdmissionError) as exc:
+            eng.submit(Request(rid="a2", tokens=[1, 2, 3],
+                               max_new_tokens=2, tenant="gold"))
+        assert exc.value.retryable
+        assert "gold" in str(exc.value)
+        # The OTHER tenant's lane is open — the cap is per tenant.
+        eng.submit(Request(rid="s0", tokens=[4, 5], max_new_tokens=2,
+                           tenant="silver"))
+        done = eng.run()
+        assert sorted(str(c.rid) for c in done) == ["a0", "a1", "s0"]
+        assert eng.stats()["admission_rejections"] == 1.0
+
+    def test_budgets_off_is_byte_identical_to_unarmed_engine(self, tiny):
+        """qos=None with tagged requests AND a qos engine with untagged
+        requests both reproduce the unarmed engine bit-for-bit."""
+        from tony_tpu.serve import Request
+
+        rng = np.random.RandomState(4)
+        prompts = [list(rng.randint(0, 256, n)) for n in (5, 11, 17)]
+
+        def run(qos=None, tenant=None):
+            eng = make_engine(tiny, qos=qos)
+            for i, p in enumerate(prompts):
+                eng.submit(Request(rid=i, tokens=p, max_new_tokens=3,
+                                   tenant=tenant))
+            out = {c.rid: c for c in eng.run()}
+            return eng, out
+
+        _, ref = run()
+        _, tagged_no_qos = run(tenant="gold")
+        armed_eng, untagged_qos = run(qos=_gold_silver())
+        for variant in (tagged_no_qos, untagged_qos):
+            for rid, want in ref.items():
+                assert variant[rid].tokens == want.tokens
+                for a, b in zip(variant[rid].logits, want.logits):
+                    assert np.array_equal(a, b)
+        st = armed_eng.stats()
+        assert st["qos_deferrals"] == 0.0 and st["tenants"] == {}
+
+    def test_tenant_accounting_drains_to_zero(self, tiny):
+        from tony_tpu.serve import Request
+
+        eng = make_engine(tiny, qos=_gold_silver())
+        rng = np.random.RandomState(5)
+        for i in range(3):
+            eng.submit(Request(rid=i, tokens=list(rng.randint(0, 256, 9)),
+                               max_new_tokens=3,
+                               tenant="gold" if i % 2 else "silver"))
+        eng.run()
+        assert eng._tenant_blocks == {}
+        assert eng.cache.free_blocks == eng.cache.n_blocks
+        st = eng.stats()
+        assert st["tenants"]["gold"]["blocks"] == 0.0
+        assert st["tenants"]["gold"]["completed"] \
+            + st["tenants"]["silver"]["completed"] == 3.0

@@ -20,6 +20,7 @@ over, re-mapped from YARN to the :mod:`tony_tpu.scheduler` substrate:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -290,6 +291,36 @@ class ApplicationMaster:
                     c.exit_code if c.exit_code else constants.EXIT_FAILURE,
                     f"executor exited with {c.exit_code} without reporting")
 
+    def _log_history_events(self, session: TonySession) -> None:
+        """Append each task's latest stats-file window to the jhist log
+        (tony_tpu.events SERVE_WINDOW / TRAIN_STEP) — the history
+        plane's ONLY collection hook: the payload is the task's already-
+        normalized heartbeat dict verbatim (no second bookkeeping path),
+        de-duplicated per task so an idle tick appends nothing. A dict
+        carrying a train step counter (the train stats writer's schema)
+        logs as TRAIN_STEP; everything else is a serve window."""
+        if self.events is None:
+            return
+        if not hasattr(self, "_history_window_sig"):
+            self._history_window_sig: Dict[str, str] = {}
+        for t in session.tasks():
+            m = t.serve_metrics
+            if not m or t.status.is_terminal:
+                continue
+            sig = json.dumps(m, sort_keys=True, default=str)
+            if self._history_window_sig.get(t.task_id) == sig:
+                continue
+            self._history_window_sig[t.task_id] = sig
+            if "step" in m and "qps" not in m:
+                self.events.train_step(
+                    t.job_type, t.index, step=int(m.get("step", 0)),
+                    step_time_s=float(m.get("step_time_s", 0.0)),
+                    collective_bytes=float(m.get("collective_bytes",
+                                                 0.0)),
+                    mfu=float(m.get("mfu", 0.0)))
+            else:
+                self.events.serve_window(t.job_type, t.index, m)
+
     def _autoscale_serve(self, session: TonySession) -> None:
         """Heartbeat-driven replica scaling for every serving jobtype
         (tony_tpu.serve): feed the replicas' piggybacked qps/p99/queue-
@@ -353,6 +384,15 @@ class ApplicationMaster:
                        if not s.get("warm_standby")]
             delta = scaling.decide(policy, len(active), samples, now=now,
                                    last_action=self._serve_scale_last[jt])
+            if delta and self.events is not None:
+                # The SELF-VERIFYING record (before the applied action
+                # updates the cooldown clock): decide()'s complete input
+                # next to the delta, so scaling.replay_decisions over
+                # the finished log reproduces this exact verdict.
+                self.events.scale_decision(
+                    jt, delta, len(active), samples, now,
+                    self._serve_scale_last[jt],
+                    dataclasses.asdict(policy))
             if delta > 0:
                 # The grant names the prefix store (when conf declares
                 # one): the fresh replica warms its prefix tier from
@@ -586,6 +626,7 @@ class ApplicationMaster:
 
                 self._handle_completed_containers(session)
                 self._check_heartbeats(session)
+                self._log_history_events(session)
                 self._autoscale_serve(session)
                 self._maybe_refresh_credentials()
 

@@ -198,6 +198,32 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.demote_watermark:
         cfg.set(conf_mod.SERVE_DEMOTE_WATERMARK,
                 str(args.demote_watermark))
+    # QoS / history plane (tony_tpu.serve.qos PR 18): validate the
+    # tenant spec at submit — parse_tenants raises on empty names,
+    # duplicates, and non-positive weights, which the replica would
+    # otherwise reject at launch, replica by replica.
+    if args.tenants:
+        from tony_tpu.serve.qos import parse_tenants
+
+        try:
+            parse_tenants(args.tenants)
+        except ValueError as e:
+            raise SystemExit(f"--tenants: {e}")
+        cfg.set(conf_mod.SERVE_QOS_TENANTS, args.tenants)
+    if args.qos_max_queue < 0:
+        raise SystemExit(f"--qos_max_queue must be >= 0, got "
+                         f"{args.qos_max_queue}")
+    if args.qos_max_queue:
+        if not args.tenants:
+            raise SystemExit("--qos_max_queue needs --tenants (the cap "
+                             "is per tenant class; without a spec it "
+                             "would be silently ignored)")
+        cfg.set(conf_mod.SERVE_QOS_MAX_QUEUE, str(args.qos_max_queue))
+    if args.slo_target_ms < 0:
+        raise SystemExit(f"--slo_target_ms must be >= 0, got "
+                         f"{args.slo_target_ms}")
+    if args.slo_target_ms:
+        cfg.set(conf_mod.SERVE_SLO_TARGET_MS, str(args.slo_target_ms))
     if args.prefix_cache:
         cfg.set(conf_mod.SERVE_PREFIX_CACHE, "true")
     if args.prefill_chunk:
@@ -510,6 +536,21 @@ def make_parser() -> argparse.ArgumentParser:
                          "into the --host_blocks tier (0 = off): "
                          "eviction pressure is drained ahead of the "
                          "work arriving, like the warm pool itself")
+    sv.add_argument("--tenants", default=None, metavar="NAME:W[,NAME:W...]",
+                    help="tenant classes with weighted-fair KV-block "
+                         "budgets, e.g. gold:3,silver:1 (bare name = "
+                         "weight 1); arms per-tenant admission QoS on "
+                         "every replica — absent, serving is "
+                         "byte-identical to an untagged fleet")
+    sv.add_argument("--qos_max_queue", type=int, default=0,
+                    help="per-tenant queue cap: past it a tenant's "
+                         "submits get typed retryable back-pressure "
+                         "(0 = unbounded; needs --tenants)")
+    sv.add_argument("--slo_target_ms", type=float, default=0.0,
+                    help="p99 latency target arming SLO-mode "
+                         "autoscaling: the gang scales on p99-vs-target "
+                         "from the heartbeat latency windows the "
+                         "history plane logs (0 = queue-depth mode)")
     sv.add_argument("--spec_k", type=int, default=0,
                     help="speculative decoding draft depth (0 = off; "
                          "k tokens drafted, verified in ONE target "

@@ -166,6 +166,20 @@ SERVE_AOT_CACHE = "tony.serve.aot-cache"        # AOT cache dir ("" = off)
 SERVE_WARM_STANDBY = "tony.serve.warm-standby"  # standby pool size (0=off)
 SERVE_DEMOTE_WATERMARK = "tony.serve.demote-watermark"  # pool frac (0=off)
 SERVE_DEMOTE_BATCH = "tony.serve.demote-batch"  # blocks/sweep (0=nb_max)
+# Multi-tenant QoS + SLO autoscaling (PR 18): the tenants CSV declares
+# the gang's QoS classes as "name:weight,..." — requests tagged with a
+# tenant get a weighted-fair share of the paged KV pool at admission
+# (work-conserving: an idle tenant's share redistributes), so one
+# tenant's prefill burst queues behind its own budget instead of
+# starving another tenant's decode floor. Untagged requests bypass
+# budgets entirely; with the CSV empty the engine is byte-identical to
+# an un-QoS'd one. The SLO target switches the autoscaler from raw
+# queue depth to p99-vs-target per gang, computed from the same latency
+# windows the history plane logs — a replayed event log reproduces the
+# live scale decisions exactly.
+SERVE_QOS_TENANTS = "tony.serve.qos.tenants"    # "name:weight,.." ("" = off)
+SERVE_QOS_MAX_QUEUE = "tony.serve.qos.max-queue"  # per-tenant cap (0 = inf)
+SERVE_SLO_TARGET_MS = "tony.serve.scale.slo-target-ms"  # p99 target (0=off)
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)

@@ -15,6 +15,28 @@ Event types carried over: APPLICATION_INITED, TASK_STARTED, TASK_FINISHED,
 APPLICATION_FINISHED. The first line of every jhist file is a metadata record
 (user, app name, started timestamp, config snapshot) so the history server
 can render a job without re-reading its config files.
+
+PR 18 makes the log LOAD-BEARING, not decorative — three widenings:
+
+* SERVE_WINDOW — one per-heartbeat serve stats window per task, sourced
+  from the SAME normalized heartbeat schema the session/router consume
+  (no second bookkeeping path): latency p50/p99, qps, queue depth,
+  admission rejections, prefix-hit/handoff/park/AOT counters, and the
+  per-tenant breakdown. The history portal's SLO dashboards and the
+  per-tenant rollups render from exactly these records.
+* TRAIN_STEP — per-step wall time, collective bytes (from
+  ``profiler.collective_report()``) and an MFU estimate, fed through
+  the executor's stats-file pickup like serve stats.
+* SCALE_DECISION — a SELF-VERIFYING autoscale record: the full decide()
+  input (policy fields, active count, samples, clock, last action) plus
+  the delta the live AM took, so replaying the log through
+  ``scaling.replay_decisions`` reproduces the run's scale decisions
+  exactly (floats round-trip bit-exact through JSON).
+
+High-rate records are bounded: with ``max_bytes`` armed the writer
+compacts through the ckpt plane's stage-and-rename idiom — lifecycle
+events survive whole, the newest half of the high-rate tail is kept.
+The write path stays jax-free.
 """
 
 from __future__ import annotations
@@ -35,8 +57,16 @@ TASK_METRICS = "TASK_METRICS"
 ALL_TASKS_RUNNING = "ALL_TASKS_RUNNING"
 TASK_FINISHED = "TASK_FINISHED"
 APPLICATION_FINISHED = "APPLICATION_FINISHED"
+SERVE_WINDOW = "SERVE_WINDOW"
+TRAIN_STEP = "TRAIN_STEP"
+SCALE_DECISION = "SCALE_DECISION"
 
 _METADATA = "METADATA"
+
+# Record types a long run emits continuously (one per task heartbeat /
+# train step): rotation's compaction victims. Lifecycle events and
+# SCALE_DECISION (low-rate, replay-bearing) always survive whole.
+_HIGH_RATE = frozenset({TASK_METRICS, SERVE_WINDOW, TRAIN_STEP})
 
 
 class EventHandler:
@@ -45,9 +75,15 @@ class EventHandler:
 
     def __init__(self, history_dir: str | Path, app_id: str,
                  conf_snapshot: Optional[Dict[str, str]] = None,
-                 app_name: str = ""):
+                 app_name: str = "", max_bytes: int = 0):
         self.history_dir = Path(history_dir)
         self.app_id = app_id
+        # Bounded rotation (0 = unbounded): past max_bytes the writer
+        # COMPACTS in place through stage-and-rename (lifecycle events
+        # whole, newest half of the high-rate tail) — a week-long serve
+        # job's log stays a bounded file, never an unbounded append.
+        self.max_bytes = int(max_bytes)
+        self.rotations = 0
         self._lock = threading.Lock()
         inter = self.history_dir / constants.EVENTS_DIR_INTERMEDIATE
         inter.mkdir(parents=True, exist_ok=True)
@@ -75,6 +111,37 @@ class EventHandler:
                 return
             self._file.write(json.dumps(record, sort_keys=True) + "\n")
             self._file.flush()
+            if self.max_bytes and self._file.tell() > self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Compact the inprogress file past ``max_bytes`` (caller holds
+        the lock): keep the metadata line, every lifecycle/scale record,
+        and the newest half of the high-rate tail, staged to a sibling
+        tmp and ``os.replace``d over the live path — the ckpt plane's
+        atomic stage-and-rename idiom, so a concurrent reader sees the
+        old file or the compacted one, never a torn half."""
+        self._file.close()
+        try:
+            records = _parse_file(self.inprogress_path)
+        except (OSError, ValueError):
+            # Unreadable under external interference: keep appending —
+            # rotation is a bound, never a reason to lose the log.
+            self._file = open(self.inprogress_path, "a", encoding="utf-8")
+            return
+        keep = [r for r in records if r.get("type") not in _HIGH_RATE]
+        high = [r for r in records if r.get("type") in _HIGH_RATE]
+        keep += high[len(high) // 2:]
+        keep.sort(key=lambda r: r.get("timestamp", 0.0))
+        tmp = Path(f"{self.inprogress_path}.tmp.{os.getpid()}")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for r in keep:
+                fh.write(json.dumps(r, sort_keys=True) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.inprogress_path)
+        self._file = open(self.inprogress_path, "a", encoding="utf-8")
+        self.rotations += 1
 
     def emit(self, event_type: str, **payload: Any) -> None:
         self._write({"type": event_type, "timestamp": time.time(),
@@ -114,6 +181,42 @@ class EventHandler:
     def application_finished(self, status: str, message: str = "") -> None:
         self.emit(APPLICATION_FINISHED, status=status, message=message)
 
+    # -- PR 18 vocabulary: the load-bearing serve/train/scale records ------
+    def serve_window(self, job_type: str, index: int,
+                     stats: Dict[str, Any]) -> None:
+        """One per-heartbeat serve stats window for one task — the
+        ALREADY-normalized heartbeat dict (session.Task.serve_metrics),
+        verbatim: the log is a recording of the schema the fleet
+        already speaks, never a second bookkeeping path."""
+        self.emit(SERVE_WINDOW, job_type=job_type, index=index,
+                  stats=dict(stats))
+
+    def train_step(self, job_type: str, index: int, step: int,
+                   step_time_s: float, collective_bytes: float = 0.0,
+                   mfu: float = 0.0) -> None:
+        """One training step's cost triple: wall time, collective bytes
+        (``profiler.collective_report()``'s total for the step plane),
+        and the caller's MFU estimate — the portal's per-step trend
+        across BENCH rounds."""
+        self.emit(TRAIN_STEP, job_type=job_type, index=index,
+                  step=int(step), step_time_s=float(step_time_s),
+                  collective_bytes=float(collective_bytes),
+                  mfu=float(mfu))
+
+    def scale_decision(self, job_type: str, delta: int, n_active: int,
+                       samples: List[Dict[str, Any]], now: float,
+                       last_action: Optional[float],
+                       policy: Dict[str, Any]) -> None:
+        """A SELF-VERIFYING autoscale record: everything
+        ``scaling.decide`` consumed (policy fields, active count,
+        samples, clock, last action) plus the delta the live AM took —
+        ``scaling.replay_decisions`` recomputes the decision from these
+        fields and must reproduce it exactly."""
+        self.emit(SCALE_DECISION, job_type=job_type, delta=int(delta),
+                  n_active=int(n_active),
+                  samples=[dict(s) for s in samples], now=float(now),
+                  last_action=last_action, policy=dict(policy))
+
     def close(self) -> None:
         """Finalize: move intermediate → finished (the reference's HDFS
         rename on job completion)."""
@@ -152,9 +255,25 @@ def _parse_file(path: str | Path) -> List[Dict[str, Any]]:
     return out
 
 
+def _finished_sibling(path: str | Path) -> Optional[Path]:
+    """The finished-dir path an intermediate jhist lands at when
+    ``EventHandler.close()`` renames it — the retry target for the
+    scan-vs-close race. None for paths that are not intermediates."""
+    p = Path(path)
+    if not p.name.endswith(constants.JHIST_INPROGRESS_SUFFIX):
+        return None
+    app_id = p.name[:-len(constants.JHIST_INPROGRESS_SUFFIX)]
+    return (p.parent.parent / constants.EVENTS_DIR_FINISHED
+            / (app_id + constants.JHIST_SUFFIX))
+
+
 def read_events(path: str | Path) -> List[Dict[str, Any]]:
     """Parse one jhist (or .inprogress) file into its event records.
-    Cached on (mtime, size); callers must not mutate the returned records."""
+    Cached on (mtime, size); callers must not mutate the returned
+    records. An intermediate path that vanished underneath us — the
+    ``list_jobs`` scan racing ``EventHandler.close()``'s rename —
+    retries at the finished path instead of raising: the records exist,
+    they just moved."""
     key = str(path)
     try:
         st = os.stat(path)
@@ -162,6 +281,9 @@ def read_events(path: str | Path) -> List[Dict[str, Any]]:
         # e.g. intermediate→finished rename raced the scan; no stale cache.
         with _parse_cache_lock:
             _parse_cache.pop(key, None)
+        fin = _finished_sibling(path)
+        if fin is not None and fin.exists():
+            return read_events(fin)
         raise
     with _parse_cache_lock:
         hit = _parse_cache.get(key)
@@ -170,7 +292,16 @@ def read_events(path: str | Path) -> List[Dict[str, Any]]:
             # actually touch (sort/filter/append); handing out the cached
             # list itself would let one caller poison every later read.
             return list(hit[2])
-    records = _parse_file(path)
+    try:
+        records = _parse_file(path)
+    except OSError:
+        # stat won the race, open lost it: same rename, same retry.
+        with _parse_cache_lock:
+            _parse_cache.pop(key, None)
+        fin = _finished_sibling(path)
+        if fin is not None and fin.exists():
+            return read_events(fin)
+        raise
     with _parse_cache_lock:
         if len(_parse_cache) >= _CACHE_MAX_FILES:
             # Drop the oldest insertion — plain dicts iterate in insertion
@@ -203,8 +334,16 @@ def job_metadata(path: str | Path) -> Dict[str, Any]:
             hit = _meta_cache.get(key)
             if hit is not None and hit[0] == st.st_mtime_ns:
                 return hit[1]
-    with open(path, encoding="utf-8") as f:
-        first = f.readline().strip()
+    try:
+        with open(path, encoding="utf-8") as f:
+            first = f.readline().strip()
+    except OSError:
+        # Same scan-vs-close rename race as read_events: the metadata
+        # line moved with the file — follow it.
+        fin = _finished_sibling(path)
+        if fin is not None and fin.exists():
+            return job_metadata(fin)
+        raise
     rec = json.loads(first) if first else {}
     meta = rec.get("payload", {}) if rec.get("type") == _METADATA else {}
     if st is not None:

@@ -25,28 +25,59 @@ from tony_tpu.util import default_workdir
 
 def default_history_dir() -> Optional[Path]:
     """The client workdir's per-job history dirs don't share one root; the
-    conventional root is ``~/.tony-tpu/history`` (set
-    ``tony.history.location`` to use it). Fall back to scanning the client
-    workdir for per-job ``history/`` subdirs."""
+    conventional root is ``~/.tony-tpu/history``. Per-job
+    ``tony.history.location`` overrides are honored by the workdir scan
+    (:func:`_job_history_root`), not here."""
     root = Path.home() / ".tony-tpu" / "history"
     return root if root.is_dir() else None
 
 
+def _job_history_root(jobdir: Path) -> Path:
+    """One job's history root: its serialized conf's
+    ``tony.history.location`` when set — the key the AM itself honors
+    when it writes the jhist (and ``tony profile`` honors when it
+    collects traces) — else the conventional ``<jobdir>/history``.
+    Before this resolution `tony history` silently missed every job
+    whose conf redirected the log."""
+    from tony_tpu import constants
+    from tony_tpu.conf import HISTORY_LOCATION, TonyConfig
+
+    conf_path = jobdir / constants.TONY_JOB_JSON
+    if conf_path.is_file():
+        try:
+            loc = TonyConfig.load(conf_path).get(HISTORY_LOCATION)
+        except (OSError, ValueError):
+            loc = None              # unreadable conf: scan falls back
+        if loc:
+            return Path(loc)
+    return jobdir / "history"
+
+
 def gather_jobs(history_dir: Optional[str | Path]) -> List[Dict[str, Any]]:
-    """All jobs under a history root, or — when no single root exists — under
-    every ``<workdir>/<app_id>/history`` the client has written."""
+    """All jobs under a history root, or — when no single root exists —
+    under every job root the client workdir knows: each jobdir's conf is
+    resolved FIRST (``tony.history.location``), then the conventional
+    ``<jobdir>/history`` fallback. Roots are deduped, so many jobs
+    sharing one conf-pointed root list each job once."""
     if history_dir is not None:
         return list(ev.list_jobs(history_dir))
-    jobs: List[Dict[str, Any]] = []
+    roots: List[Path] = []
     root = default_history_dir()
     if root is not None:
-        jobs.extend(ev.list_jobs(root))
+        roots.append(root)
     workdir = default_workdir()
     if workdir.is_dir():
         for jobdir in sorted(workdir.iterdir()):
-            h = jobdir / "history"
-            if h.is_dir():
-                jobs.extend(ev.list_jobs(h))
+            if jobdir.is_dir():
+                roots.append(_job_history_root(jobdir))
+    jobs: List[Dict[str, Any]] = []
+    seen = set()
+    for r in roots:
+        key = str(r.resolve())
+        if key in seen or not r.is_dir():
+            continue
+        seen.add(key)
+        jobs.extend(ev.list_jobs(r))
     return jobs
 
 
@@ -95,6 +126,56 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
                 {"timestamp": r["timestamp"], **(p.get("metrics") or {})})
     timelines = {tid: _downsample(samples)
                  for tid, samples in timelines.items()}
+    # History plane (PR 18): serve latency windows, train step costs,
+    # and the autoscaler's self-verifying decision records — all read
+    # from the SAME jhist, zero extra collection hooks.
+    serve_windows: Dict[str, List[Dict[str, Any]]] = {}
+    train_steps: Dict[str, List[Dict[str, Any]]] = {}
+    scale_decisions: List[Dict[str, Any]] = []
+    for r in records:
+        p = r["payload"]
+        if r["type"] == ev.SERVE_WINDOW:
+            tid = f"{p['job_type']}:{p['index']}"
+            serve_windows.setdefault(tid, []).append(
+                {"timestamp": r["timestamp"], **(p.get("stats") or {})})
+        elif r["type"] == ev.TRAIN_STEP:
+            tid = f"{p['job_type']}:{p['index']}"
+            train_steps.setdefault(tid, []).append(
+                {"timestamp": r["timestamp"],
+                 **{k: v for k, v in p.items()
+                    if k not in ("job_type", "index")}})
+        elif r["type"] == ev.SCALE_DECISION:
+            scale_decisions.append(dict(p, timestamp=r["timestamp"]))
+    serve_windows = {tid: _downsample(s) for tid, s in serve_windows.items()}
+    train_steps = {tid: _downsample(s) for tid, s in train_steps.items()}
+    # Per-tenant SLO rollup from each task's NEWEST window (qps/queued/
+    # blocks are instantaneous — summed across tasks; p99 is the fleet
+    # worst; completed is a counter — summed).
+    tenant_slo: Dict[str, Dict[str, float]] = {}
+    for tid, samples in serve_windows.items():
+        last = samples[-1]
+        tenants = last.get("tenants") or {}
+        if not isinstance(tenants, dict):
+            continue
+        for name, t in tenants.items():
+            if not isinstance(t, dict):
+                continue
+            agg = tenant_slo.setdefault(name, {
+                "qps": 0.0, "tokens_per_s": 0.0, "p99_ms": 0.0,
+                "queued": 0.0, "blocks": 0.0, "completed": 0.0})
+            for k in ("qps", "tokens_per_s", "queued", "blocks",
+                      "completed"):
+                agg[k] += float(t.get(k, 0.0))
+            agg["p99_ms"] = max(agg["p99_ms"], float(t.get("p99_ms", 0.0)))
+    # Replay verdicts: the load-bearing check — each SCALE_DECISION
+    # recomputed from its own logged inputs must match the live delta.
+    scale_replay: List[Dict[str, Any]] = []
+    if scale_decisions:
+        from tony_tpu.serve import scaling
+        try:
+            scale_replay = scaling.replay_decisions(scale_decisions)
+        except (KeyError, TypeError, ValueError):
+            scale_replay = []       # pre-PR-18 or truncated records
     all_running = next((r for r in records
                         if r["type"] == ev.ALL_TASKS_RUNNING), None)
     # Collected profiler traces live next to the jhist tree:
@@ -108,6 +189,11 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
         "final": final,
         "tasks": tasks,
         "metrics_timelines": timelines,
+        "serve_windows": serve_windows,
+        "train_steps": train_steps,
+        "tenant_slo": tenant_slo,
+        "scale_decisions": scale_decisions,
+        "scale_replay": scale_replay,
         "traces": list_traces(history_root, job["app_id"]),
         "submit_to_running_s": (all_running or {}).get(
             "payload", {}).get("submit_to_running_s"),
@@ -155,6 +241,44 @@ def render_show(detail: Dict[str, Any]) -> str:
             out.append(f"    {t['job_type']}:{t['index']} {t['status']} "
                        f"exit={t.get('exit_code')}{mstr}"
                        + (f" — {t['diagnostics']}" if t.get("diagnostics") else ""))
+    if detail.get("tenant_slo"):
+        out.append("  tenant SLO (latest window, fleet rollup):")
+        for name, t in sorted(detail["tenant_slo"].items()):
+            out.append(f"    {name}: p99={t['p99_ms']:.1f}ms "
+                       f"qps={t['qps']:.2f} tok/s={t['tokens_per_s']:.1f} "
+                       f"queued={t['queued']:.0f} blocks={t['blocks']:.0f} "
+                       f"completed={t['completed']:.0f}")
+    if detail.get("serve_windows"):
+        out.append("  serve windows:")
+        for tid, samples in sorted(detail["serve_windows"].items()):
+            last = samples[-1]
+            out.append(f"    {tid}: {len(samples)} window(s), last "
+                       f"p99={float(last.get('p99_ms', 0.0)):.1f}ms "
+                       f"qps={float(last.get('qps', 0.0)):.2f} "
+                       f"queue={float(last.get('queue_depth', 0.0)):.0f} "
+                       f"rejected="
+                       f"{float(last.get('admission_rejections', 0.0)):.0f}")
+    if detail.get("train_steps"):
+        out.append("  train steps:")
+        for tid, samples in sorted(detail["train_steps"].items()):
+            last = samples[-1]
+            mean_t = sum(float(s.get("step_time_s", 0.0))
+                         for s in samples) / len(samples)
+            out.append(f"    {tid}: {len(samples)} step(s), mean "
+                       f"{mean_t * 1e3:.1f}ms/step, last "
+                       f"step={int(last.get('step', 0))} "
+                       f"mfu={float(last.get('mfu', 0.0)):.3f} "
+                       f"coll={float(last.get('collective_bytes', 0.0)):.0f}B")
+    if detail.get("scale_replay"):
+        ok = sum(1 for v in detail["scale_replay"] if v["match"])
+        out.append(f"  scale decisions ({ok}/{len(detail['scale_replay'])} "
+                   f"replay exactly):")
+        for p, v in zip(detail["scale_decisions"], detail["scale_replay"]):
+            when = time.strftime("%H:%M:%S", time.localtime(p["timestamp"]))
+            mark = "ok" if v["match"] else f"MISMATCH(replay={v['replayed']})"
+            out.append(f"    {when} {p.get('job_type')}: delta="
+                       f"{p.get('delta'):+d} active={p.get('n_active')} "
+                       f"[{mark}]")
     if detail.get("traces"):
         out.append("  traces:")
         for tid, files in sorted(detail["traces"].items()):
@@ -233,6 +357,72 @@ def _job_page(detail: Dict[str, Any]) -> str:
                 parts.append(f"<tr><td>{when}</td>"
                              f"<td>{html.escape(vals)}</td></tr>")
             parts.append("</table>")
+    if detail.get("tenant_slo"):
+        parts.append("<h3>Tenant SLO dashboard</h3><table><tr>"
+                     "<th>tenant</th><th>p99 ms</th><th>qps</th>"
+                     "<th>tok/s</th><th>queued</th><th>blocks</th>"
+                     "<th>completed</th></tr>")
+        for name, t in sorted(detail["tenant_slo"].items()):
+            parts.append(
+                f"<tr><td>{html.escape(name)}</td>"
+                f"<td>{t['p99_ms']:.1f}</td><td>{t['qps']:.2f}</td>"
+                f"<td>{t['tokens_per_s']:.1f}</td>"
+                f"<td>{t['queued']:.0f}</td><td>{t['blocks']:.0f}</td>"
+                f"<td>{t['completed']:.0f}</td></tr>")
+        parts.append("</table>")
+    if detail.get("serve_windows"):
+        parts.append("<h3>Serve latency windows</h3>")
+        for tid, samples in sorted(detail["serve_windows"].items()):
+            parts.append(f"<h4>{html.escape(tid)} ({len(samples)} "
+                         f"windows)</h4><table><tr><th>time</th>"
+                         "<th>qps</th><th>p99 ms</th><th>queue</th>"
+                         "<th>rejected</th><th>deferred</th></tr>")
+            for s in samples:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(s["timestamp"]))
+                parts.append(
+                    f"<tr><td>{when}</td>"
+                    f"<td>{float(s.get('qps', 0.0)):.2f}</td>"
+                    f"<td>{float(s.get('p99_ms', 0.0)):.1f}</td>"
+                    f"<td>{float(s.get('queue_depth', 0.0)):.0f}</td>"
+                    f"<td>{float(s.get('admission_rejections', 0.0)):.0f}"
+                    f"</td>"
+                    f"<td>{float(s.get('qos_deferrals', 0.0)):.0f}</td>"
+                    f"</tr>")
+            parts.append("</table>")
+    if detail.get("train_steps"):
+        parts.append("<h3>Train step trend</h3>")
+        for tid, samples in sorted(detail["train_steps"].items()):
+            parts.append(f"<h4>{html.escape(tid)} ({len(samples)} "
+                         f"steps)</h4><table><tr><th>time</th>"
+                         "<th>step</th><th>step ms</th>"
+                         "<th>collective B</th><th>MFU</th></tr>")
+            for s in samples:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(s["timestamp"]))
+                parts.append(
+                    f"<tr><td>{when}</td><td>{int(s.get('step', 0))}</td>"
+                    f"<td>{float(s.get('step_time_s', 0.0)) * 1e3:.1f}</td>"
+                    f"<td>{float(s.get('collective_bytes', 0.0)):.0f}</td>"
+                    f"<td>{float(s.get('mfu', 0.0)):.3f}</td></tr>")
+            parts.append("</table>")
+    if detail.get("scale_replay"):
+        parts.append("<h3>Autoscale decisions (replayed)</h3><table><tr>"
+                     "<th>time</th><th>gang</th><th>delta</th>"
+                     "<th>active</th><th>replay</th></tr>")
+        for p, v in zip(detail["scale_decisions"], detail["scale_replay"]):
+            when = time.strftime("%H:%M:%S", time.localtime(p["timestamp"]))
+            if v["match"]:
+                verdict = "<b class='ok'>match</b>"
+            else:
+                verdict = (f"<b class='bad'>mismatch "
+                           f"(replay={v['replayed']})</b>")
+            parts.append(
+                f"<tr><td>{when}</td>"
+                f"<td>{html.escape(str(p.get('job_type')))}</td>"
+                f"<td>{p.get('delta'):+d}</td><td>{p.get('n_active')}</td>"
+                f"<td>{verdict}</td></tr>")
+        parts.append("</table>")
     if detail.get("traces"):
         parts.append("<h3>Profiler traces</h3><table><tr><th>task</th>"
                      "<th>file</th><th>bytes</th></tr>")

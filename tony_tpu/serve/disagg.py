@@ -209,11 +209,12 @@ class DecodeFront:
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
                  rid: Optional[Any] = None,
-                 conv: Optional[Any] = None) -> Any:
+                 conv: Optional[Any] = None,
+                 tenant: Optional[str] = None) -> Any:
         """The colocated fallback path (the decode engine prefills for
         itself when a handoff could not be placed)."""
         return self.front.generate(tokens, max_new_tokens, rid=rid,
-                                   conv=conv)
+                                   conv=conv, tenant=tenant)
 
 
 class PrefillFront:
@@ -234,7 +235,8 @@ class PrefillFront:
     def prefill_handoff(self, tokens: Sequence[int], max_new_tokens: int,
                         rid: Optional[Any] = None,
                         decode: Any = None,
-                        conv: Optional[Any] = None) -> Any:
+                        conv: Optional[Any] = None,
+                        tenant: Optional[str] = None) -> Any:
         if decode is None:
             raise ValueError("prefill_handoff needs a decode target "
                              "(a DecodeFront or a host:port address)")
@@ -251,7 +253,8 @@ class PrefillFront:
             try:
                 handoff = eng.prefill_only(Request(
                     rid=rid, tokens=[int(t) for t in tokens],
-                    max_new_tokens=int(max_new_tokens), conv=conv))
+                    max_new_tokens=int(max_new_tokens), conv=conv,
+                    tenant=tenant))
             except AdmissionError as e:
                 if not getattr(e, "retryable", True):
                     raise               # never fits: same as colocated submit
@@ -280,9 +283,10 @@ class PrefillFront:
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
                  rid: Optional[Any] = None,
-                 conv: Optional[Any] = None) -> Any:
+                 conv: Optional[Any] = None,
+                 tenant: Optional[str] = None) -> Any:
         return self.front.generate(tokens, max_new_tokens, rid=rid,
-                                   conv=conv)
+                                   conv=conv, tenant=tenant)
 
 
 def _dial_decode(address: str, timeout: float) -> Any:
@@ -300,10 +304,11 @@ def _dial_decode(address: str, timeout: float) -> Any:
             with RpcClient(address, timeout=timeout) as client:
                 return client.call("kv_import", payload=payload)
 
-        def generate(self, tokens, max_new_tokens, rid=None, conv=None):
+        def generate(self, tokens, max_new_tokens, rid=None, conv=None,
+                     tenant=None):
             with RpcClient(address, timeout=timeout) as client:
                 return client.call("generate", tokens=list(tokens),
                                    max_new_tokens=int(max_new_tokens),
-                                   rid=rid, conv=conv)
+                                   rid=rid, conv=conv, tenant=tenant)
 
     return _Decode()

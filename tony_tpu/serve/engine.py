@@ -93,6 +93,11 @@ class Request:
     # and the NEXT request carrying the same handle resumes from the
     # parked blocks instead of re-prefilling the shared history.
     conv: Optional[Any] = None
+    # Tenant tag (tony_tpu.serve.qos): names the request's QoS class on
+    # a budget-armed engine and keys the per-tenant heartbeat breakdown.
+    # None (the default) bypasses budgets entirely — the untagged path
+    # is byte-identical to an engine without QoS.
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -117,11 +122,16 @@ class Completion:
 
 class _Seq:
     __slots__ = ("rid", "tokens", "n_prompt", "remaining", "logits",
-                 "t_submit", "pf_pos", "published", "hkey", "conv")
+                 "t_submit", "pf_pos", "published", "hkey", "conv",
+                 "tenant", "qcharge")
 
     def __init__(self, req: Request, t_submit: float):
         self.rid = req.rid
         self.conv = req.conv
+        self.tenant = getattr(req, "tenant", None)
+        # Device blocks charged to this sequence's tenant at admission
+        # (0 on untagged or un-budgeted engines); _evict releases it.
+        self.qcharge = 0
         self.tokens: List[int] = list(req.tokens)
         self.n_prompt = len(req.tokens)
         self.remaining = int(req.max_new_tokens)
@@ -399,7 +409,8 @@ class ServeEngine(PagedModelRunner):
                  async_offload: bool = False,
                  aot_cache: Optional[Any] = None,
                  warm_standby: bool = False,
-                 demote_watermark: float = 0.0, demote_batch: int = 0):
+                 demote_watermark: float = 0.0, demote_batch: int = 0,
+                 qos: Optional[Any] = None):
         if join_policy not in ("continuous", "static"):
             raise ValueError(f"unknown join_policy {join_policy!r} "
                              "(continuous|static)")
@@ -488,6 +499,19 @@ class ServeEngine(PagedModelRunner):
         self.keep_logits = keep_logits
         self.join_policy = join_policy
         self.tag = tag
+        # Per-tenant QoS (tony_tpu.serve.qos.QosPolicy; None = off — the
+        # byte-identical untagged path). The policy gates the ADMISSION
+        # scan only: the paged pool's refcount/free/LRU partition never
+        # sees tenants; an over-budget tenant's requests simply wait in
+        # the queue while later tenants' requests admit past them.
+        self.qos = qos
+        # Device blocks currently reserved per tenant (admission extent,
+        # released at eviction) + lifetime per-tenant completions — the
+        # heartbeat breakdown and the budget denominator's active set.
+        self._tenant_blocks: Dict[str, int] = {}
+        self._tenant_completed: Dict[str, int] = {}
+        self.admission_rejections = 0
+        self.qos_deferrals = 0
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._running: List[_Seq] = []
@@ -564,7 +588,23 @@ class ServeEngine(PagedModelRunner):
                 f"it can never be admitted",
                 needed_blocks=needed,
                 free_blocks=self.cache.free_blocks, retryable=False)
+        tenant = getattr(req, "tenant", None)
         with self._lock:
+            if self.qos is not None and tenant is not None \
+                    and self.qos.max_queue:
+                depth = sum(1 for r, _ in self._queue
+                            if getattr(r, "tenant", None) == tenant)
+                if depth >= self.qos.max_queue:
+                    # Typed, retryable back-pressure to the BURSTING
+                    # tenant only: its pending queue is full, so the
+                    # caller backs off — the victim tenant's submits
+                    # never see this path.
+                    self.admission_rejections += 1
+                    raise AdmissionError(
+                        f"request {req.rid!r}: tenant {tenant!r} queue "
+                        f"full ({depth}/{self.qos.max_queue} pending)",
+                        needed_blocks=needed,
+                        free_blocks=self.cache.free_blocks)
             self._queue.append((req, time.monotonic()))
 
     @property
@@ -872,6 +912,9 @@ class ServeEngine(PagedModelRunner):
         if self.join_policy == "static" and (self._running
                                              or self._prefilling):
             return
+        if self.qos is not None:
+            self._join_qos(results)
+            return
         while len(self._running) + len(self._prefilling) \
                 < self.max_running:
             with self._lock:
@@ -899,6 +942,86 @@ class ServeEngine(PagedModelRunner):
             else:
                 self._running.append(seq)
 
+    def _qos_active(self) -> set:
+        """The budget denominator's active-tenant set: tenants holding
+        device blocks or waiting in the queue (caller holds the lock).
+        Work conservation falls out — an idle tenant leaves the set and
+        its share redistributes."""
+        active = {t for t, n in self._tenant_blocks.items() if n > 0}
+        for r, _ in self._queue:
+            t = getattr(r, "tenant", None)
+            if t is not None:
+                active.add(t)
+        return active
+
+    def _join_qos(self, results: List[Completion]) -> None:
+        """The budget-armed admission scan: walk the queue in FIFO
+        order, DEFER requests whose tenant is over its weighted-fair
+        block budget (and every later request of that tenant — per-
+        tenant order is preserved), admit the first request that fits.
+        Untagged requests bypass budgets. Pool pressure from ``_admit``
+        ends the scan whole, exactly like the unarmed path — the
+        deferral mechanism is skip-over, never reorder-within-tenant
+        and never eviction."""
+        blocked: set = set()
+        while len(self._running) + len(self._prefilling) \
+                < self.max_running:
+            picked = None
+            with self._lock:
+                if not self._queue:
+                    return
+                active = self._qos_active()
+                for i, (req, t_submit) in enumerate(self._queue):
+                    tenant = getattr(req, "tenant", None)
+                    if tenant is None:
+                        picked = (i, req, t_submit)
+                        break
+                    if tenant in blocked:
+                        continue
+                    needed = self.cache.blocks_for(
+                        len(req.tokens) + req.max_new_tokens)
+                    budget = self.qos.budget(
+                        tenant, self.cache.n_blocks, active)
+                    if self._tenant_blocks.get(tenant, 0) + needed \
+                            > budget:
+                        blocked.add(tenant)
+                        self.qos_deferrals += 1
+                        continue
+                    picked = (i, req, t_submit)
+                    break
+            if picked is None:
+                return                     # every waiter is over budget
+            i, req, t_submit = picked
+            try:
+                start, matched, keys = self._admit(req)
+            except AdmissionError:
+                return                      # pool pressure: stay queued
+            with self._lock:
+                # Index i is still req's slot: submit only APPENDS and
+                # this drive thread is the only popper (the front's
+                # single-driver contract).
+                del self._queue[i]
+                tenant = getattr(req, "tenant", None)
+                if tenant is not None:
+                    charge = self.cache.blocks_for(
+                        len(req.tokens) + req.max_new_tokens)
+                    self._tenant_blocks[tenant] = \
+                        self._tenant_blocks.get(tenant, 0) + charge
+            seq = _Seq(req, t_submit)
+            if seq.tenant is not None:
+                seq.qcharge = self.cache.blocks_for(
+                    len(req.tokens) + req.max_new_tokens)
+            seq.pf_pos = start
+            self._seed_publication(seq, matched, keys)
+            if self.prefill_chunk is not None:
+                self._prefilling.append(seq)
+                continue
+            self._prefill(seq)
+            if seq.remaining <= 0:          # max_new_tokens == 1
+                self._evict(seq, results)
+            else:
+                self._running.append(seq)
+
     def _evict(self, seq: _Seq, results: List[Completion]) -> None:
         # Conversation parking: a host-tier engine keeps a finished
         # conversation-tagged turn's KV (demoted to host RAM) instead
@@ -915,7 +1038,18 @@ class ServeEngine(PagedModelRunner):
         # lint's guarded-elsewhere rule, pinned by test_concurrency.
         with self._lock:
             self._events.append((now, now - seq.t_submit,
-                                 len(seq.tokens) - seq.n_prompt))
+                                 len(seq.tokens) - seq.n_prompt,
+                                 seq.tenant))
+            if seq.tenant is not None:
+                if seq.qcharge:
+                    left = self._tenant_blocks.get(seq.tenant, 0) \
+                        - seq.qcharge
+                    if left > 0:
+                        self._tenant_blocks[seq.tenant] = left
+                    else:
+                        self._tenant_blocks.pop(seq.tenant, None)
+                self._tenant_completed[seq.tenant] = \
+                    self._tenant_completed.get(seq.tenant, 0) + 1
         self._completed += 1
         self._tokens_out += len(seq.tokens) - seq.n_prompt
         results.append(Completion(
@@ -990,6 +1124,7 @@ class ServeEngine(PagedModelRunner):
             "max_new_tokens": int(req.max_new_tokens),
             "length": n,
             "conv": req.conv,
+            "tenant": getattr(req, "tenant", None),
             "keys": wire_keys,
             "blocks": self.cache.export_blocks(req.rid, n),
             **self.cache.wire_header(),
@@ -1009,7 +1144,8 @@ class ServeEngine(PagedModelRunner):
         # admission, one emitted token).
         now = time.monotonic()
         with self._lock:
-            self._events.append((now, now - seq.t_submit, 1))
+            self._events.append((now, now - seq.t_submit, 1,
+                                 seq.tenant))
         self._completed += 1
         self._tokens_out += 1
         return payload
@@ -1144,7 +1280,9 @@ class ServeEngine(PagedModelRunner):
             raise
         seq = _Seq(Request(rid=rid, tokens=tokens,
                            max_new_tokens=max_new,
-                           conv=payload.get("conv")), time.monotonic())
+                           conv=payload.get("conv"),
+                           tenant=payload.get("tenant")),
+                   time.monotonic())
         seq.pf_pos = n                     # the prompt arrived computed
         seq.tokens.append(first)
         seq.remaining -= 1                 # the prefill side emitted it
@@ -1332,19 +1470,55 @@ class ServeEngine(PagedModelRunner):
         now = time.monotonic()
         with self._lock:
             events = list(self._events)
-        recent = [(l, n) for t, l, n in events
+            tenant_blocks = dict(self._tenant_blocks)
+            tenant_completed = dict(self._tenant_completed)
+            tenant_queued: Dict[str, int] = {}
+            for r, _ in self._queue:
+                ten = getattr(r, "tenant", None)
+                if ten is not None:
+                    tenant_queued[ten] = tenant_queued.get(ten, 0) + 1
+            rejections = self.admission_rejections
+        recent = [(l, n, ten) for t, l, n, ten in events
                   if now - t <= self.stats_window_s]
-        lat = sorted(l for l, _ in recent)
+        lat = sorted(l for l, _, _ in recent)
         dt = max(1e-9, min(self.stats_window_s, now - self._t0))
 
-        def pct(p: float) -> float:
-            if not lat:
+        def _pct_of(vals: List[float], p: float) -> float:
+            if not vals:
                 return 0.0
-            return lat[min(len(lat) - 1, int(p * (len(lat) - 1) + 0.5))]
+            return vals[min(len(vals) - 1,
+                            int(p * (len(vals) - 1) + 0.5))]
+
+        def pct(p: float) -> float:
+            return _pct_of(lat, p)
+
+        # Per-tenant breakdown (tony_tpu.serve.qos): same window, same
+        # percentile rule as the top-level numbers. Empty dict on an
+        # untagged engine — the uniform-schema rule: every engine
+        # flavor publishes the key, consumers never branch on kind.
+        per_lat: Dict[str, List[float]] = {}
+        per_tok: Dict[str, float] = {}
+        for l, n, ten in recent:
+            if ten is None:
+                continue
+            per_lat.setdefault(ten, []).append(l)
+            per_tok[ten] = per_tok.get(ten, 0.0) + n
+        tenants: Dict[str, Dict[str, float]] = {}
+        for ten in (set(per_lat) | set(tenant_blocks)
+                    | set(tenant_queued) | set(tenant_completed)):
+            lats = sorted(per_lat.get(ten, []))
+            tenants[ten] = {
+                "qps": len(lats) / dt,
+                "tokens_per_s": per_tok.get(ten, 0.0) / dt,
+                "p99_ms": 1e3 * _pct_of(lats, 0.99),
+                "queued": float(tenant_queued.get(ten, 0)),
+                "blocks": float(tenant_blocks.get(ten, 0)),
+                "completed": float(tenant_completed.get(ten, 0)),
+            }
 
         stats = {
             "qps": len(recent) / dt,
-            "tokens_per_s": sum(n for _, n in recent) / dt,
+            "tokens_per_s": sum(n for _, n, _ in recent) / dt,
             "p50_ms": 1e3 * pct(0.50),
             "p99_ms": 1e3 * pct(0.99),
             "queue_depth": float(self.queue_depth),
@@ -1405,6 +1579,15 @@ class ServeEngine(PagedModelRunner):
             "compile_ms": float(self.compile_ms),
             "warm_standby": 1.0 if self.warm_standby else 0.0,
             "daemon_demotions": float(self.daemon_demotions),
+            # Multi-tenant QoS telemetry (PR 18): zeros / empty dict on
+            # untagged engines — the uniform-schema rule again. The
+            # tenants dict is the ONE nested value the heartbeat schema
+            # carries (normalize_serve_telemetry normalizes one level
+            # of dict-of-scalars); the history plane's SLO dashboards
+            # and the per-tenant billing rollups both read it.
+            "admission_rejections": float(rejections),
+            "qos_deferrals": float(self.qos_deferrals),
+            "tenants": tenants,
         }
         stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
@@ -1524,15 +1707,17 @@ class EngineFront:
 
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
                  rid: Optional[Any] = None,
-                 conv: Optional[Any] = None) -> Completion:
+                 conv: Optional[Any] = None,
+                 tenant: Optional[str] = None) -> Completion:
         """Submit one request and drive the shared engine until it
         completes. ``conv`` tags the request with its conversation
-        handle so a host-tier engine parks/resumes it across turns."""
+        handle so a host-tier engine parks/resumes it across turns;
+        ``tenant`` names its QoS class on a budget-armed engine."""
         if rid is None:
             rid = self.fresh_rid()
         self.engine.submit(Request(rid=rid, tokens=list(tokens),
                                    max_new_tokens=int(max_new_tokens),
-                                   conv=conv))
+                                   conv=conv, tenant=tenant))
         return self._drive_until(rid)
 
     def _drive_until(self, rid: Any) -> Completion:

@@ -71,7 +71,8 @@ class Replica:
                  aot_cache: Optional[str] = None,
                  warm_standby: bool = False,
                  demote_watermark: float = 0.0,
-                 demote_batch: int = 0):
+                 demote_batch: int = 0,
+                 qos: Optional[Any] = None):
         from tony_tpu._trace import trace_record
         from tony_tpu.models import get_model
         from tony_tpu.serve.disagg import DecodeFront, PrefillFront
@@ -118,7 +119,7 @@ class Replica:
                 async_offload=host_blocks > 0, aot_cache=self._aot,
                 warm_standby=warm_standby,
                 demote_watermark=demote_watermark,
-                demote_batch=demote_batch, **draft_kw)
+                demote_batch=demote_batch, qos=qos, **draft_kw)
         else:
             self.engine = ServeEngine(
                 self.model, params, ctx_max=ctx_max,
@@ -130,7 +131,7 @@ class Replica:
                 async_offload=host_blocks > 0, aot_cache=self._aot,
                 warm_standby=warm_standby,
                 demote_watermark=demote_watermark,
-                demote_batch=demote_batch)
+                demote_batch=demote_batch, qos=qos)
         trace_record("serve", "replica", model=model_name,
                      ckpt_step=step, path_prefix=prefix,
                      dtype_policy=dtype_policy, spec_k=int(spec_k),
@@ -229,26 +230,31 @@ class Replica:
     # -- request path ------------------------------------------------------
     def generate(self, tokens: Sequence[int], max_new_tokens: int,
                  rid: Optional[Any] = None,
-                 conv: Optional[Any] = None) -> Completion:
+                 conv: Optional[Any] = None,
+                 tenant: Optional[str] = None) -> Completion:
         """Submit one request and drive the shared engine until it
         completes. Thread-safe: concurrent callers interleave on the
         drive lock (:class:`~tony_tpu.serve.engine.EngineFront` — the
         same loop the router's in-process transport runs), so their
         requests ride one continuous batch. ``conv`` is the
-        conversation handle arming park/resume on a host-tier engine."""
+        conversation handle arming park/resume on a host-tier engine;
+        ``tenant`` is the QoS class the engine's admission budgets
+        meter (tony_tpu.serve.qos — ignored on an unloaded engine)."""
         return self._front.generate(tokens, max_new_tokens, rid=rid,
-                                    conv=conv)
+                                    conv=conv, tenant=tenant)
 
     # -- disaggregated handoff (tony_tpu.serve.disagg) ---------------------
     def prefill_handoff(self, tokens: Sequence[int], max_new_tokens: int,
                         rid: Optional[Any] = None,
                         decode: Any = None,
-                        conv: Optional[Any] = None) -> Completion:
+                        conv: Optional[Any] = None,
+                        tenant: Optional[str] = None) -> Completion:
         """Prefill-role request path: prefill ``tokens``, ship the KV
         blocks to ``decode`` (an address or an in-process receiver),
         return the completion the decode side drove to the end."""
         return self._prefill_front.prefill_handoff(
-            tokens, max_new_tokens, rid=rid, decode=decode, conv=conv)
+            tokens, max_new_tokens, rid=rid, decode=decode, conv=conv,
+            tenant=tenant)
 
     def kv_offer(self, keys: Sequence[str]) -> int:
         return self._decode_front.kv_offer(keys)
@@ -341,9 +347,11 @@ class _ReplicaRpcHandler:
 
     def rpc_generate(self, tokens: List[int], max_new_tokens: int = 16,
                      rid: Optional[str] = None,
-                     conv: Optional[str] = None) -> Dict[str, Any]:
+                     conv: Optional[str] = None,
+                     tenant: Optional[str] = None) -> Dict[str, Any]:
         return self._wire(self.replica.generate(tokens, max_new_tokens,
-                                                rid=rid, conv=conv))
+                                                rid=rid, conv=conv,
+                                                tenant=tenant))
 
     def rpc_serve_stats(self) -> Dict[str, float]:
         return self.replica.engine.stats()
@@ -353,7 +361,8 @@ class _ReplicaRpcHandler:
                             max_new_tokens: int = 16,
                             rid: Optional[str] = None,
                             decode_address: Optional[str] = None,
-                            conv: Optional[str] = None
+                            conv: Optional[str] = None,
+                            tenant: Optional[str] = None
                             ) -> Dict[str, Any]:
         """The router's disaggregated dispatch verb: prefill here, ship
         the KV replica-to-replica to ``decode_address``, return the
@@ -362,7 +371,7 @@ class _ReplicaRpcHandler:
         re-types them for its fallback split."""
         out = self.replica.prefill_handoff(tokens, max_new_tokens,
                                            rid=rid, decode=decode_address,
-                                           conv=conv)
+                                           conv=conv, tenant=tenant)
         return out if isinstance(out, dict) else self._wire(out)
 
     def rpc_kv_offer(self, keys: List[str]) -> int:
@@ -419,6 +428,12 @@ def main() -> int:
     if warm_conf is None:
         warm_conf = conf.get(SERVE_WARM_STANDBY)
     warm_pool = int(warm_conf or 0)
+    # QoS plane (tony_tpu.serve.qos): a tenant spec in the conf arms
+    # weighted-fair admission budgets; absent, from_conf returns None
+    # and the engine runs the untagged path byte-identical to before.
+    from tony_tpu.serve.qos import QosPolicy
+
+    qos = QosPolicy.from_conf(conf)
     task_index = int(os.environ.get(constants.ENV_TASK_INDEX) or 0)
     warm_standby = warm_pool > 0 and task_index >= conf.instances(job_type)
     replica = Replica(
@@ -444,7 +459,8 @@ def main() -> int:
         aot_cache=conf.get(SERVE_AOT_CACHE) or None,
         warm_standby=warm_standby,
         demote_watermark=float(conf.get(SERVE_DEMOTE_WATERMARK) or 0.0),
-        demote_batch=conf.get_int(SERVE_DEMOTE_BATCH, 0))
+        demote_batch=conf.get_int(SERVE_DEMOTE_BATCH, 0),
+        qos=qos)
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

@@ -385,7 +385,8 @@ class RequestRouter:
                          max_new_tokens: int, *,
                          session_id: Optional[Any],
                          rid: Optional[Any],
-                         max_attempts: int) -> Dict[str, Any]:
+                         max_attempts: int,
+                         tenant: Optional[str] = None) -> Dict[str, Any]:
         """Prefill-gang dispatch + KV handoff, with the PR 13 failover
         split kept intact: a TRANSPORT fault (``OSError`` family) marks
         the replica down and re-dispatches; a typed
@@ -402,8 +403,12 @@ class RequestRouter:
         # conv rides the handoff payload to the decode engine (and the
         # fallback's colocated generate) — the decode replica is where
         # the conversation's generated KV lives, so it is the one that
-        # parks and resumes it.
+        # parks and resumes it. tenant rides the same way (the decode
+        # engine is where QoS budgets meter the request); tagless
+        # requests ship no kwarg, so older replica stubs keep working.
         kw = {} if session_id is None else {"conv": str(session_id)}
+        if tenant is not None:
+            kw["tenant"] = str(tenant)
         for _ in range(max(1, int(max_attempts))):
             pf, dc = self.route_split(tokens, session_id)
             if pf is None:
@@ -464,7 +469,8 @@ class RequestRouter:
             return self._dispatch_colocated(tokens, max_new_tokens,
                                             session_id=session_id,
                                             rid=rid,
-                                            max_attempts=max_attempts)
+                                            max_attempts=max_attempts,
+                                            tenant=tenant)
         raise NoReplicaError(
             f"disaggregated dispatch failed after "
             f"{max_attempts} attempt(s): {last_err}") from last_err
@@ -479,7 +485,8 @@ class RequestRouter:
     def dispatch(self, tokens: Sequence[int], max_new_tokens: int, *,
                  session_id: Optional[Any] = None,
                  rid: Optional[Any] = None,
-                 max_attempts: int = 3) -> Dict[str, Any]:
+                 max_attempts: int = 3,
+                 tenant: Optional[str] = None) -> Dict[str, Any]:
         """Route + generate with failover: a replica whose TRANSPORT
         fails (dead socket, refused dial — ``OSError`` family) is
         marked down (until its next heartbeat) and the request
@@ -501,21 +508,25 @@ class RequestRouter:
         # path — so no separate pre-scan of the fleet is needed here.
         return self._dispatch_disagg(
             tokens, max_new_tokens, session_id=session_id, rid=rid,
-            max_attempts=max_attempts)
+            max_attempts=max_attempts, tenant=tenant)
 
     def _dispatch_colocated(self, tokens: Sequence[int],
                             max_new_tokens: int, *,
                             session_id: Optional[Any] = None,
                             rid: Optional[Any] = None,
-                            max_attempts: int = 3) -> Dict[str, Any]:
+                            max_attempts: int = 3,
+                            tenant: Optional[str] = None) -> Dict[str, Any]:
         last_err: Optional[Exception] = None
         # The session id doubles as the engine-side conversation handle
         # (conv): a host-tier replica parks the turn's KV under it and
         # the next turn — re-pinned here by affinity or the parked
         # digest — resumes instead of re-prefilling. Sessionless
         # requests ship no kwarg, so pre-PR 16 client stubs keep
-        # working unchanged.
+        # working unchanged; tenant follows the same optional-kwarg
+        # discipline for the QoS plane (tony_tpu.serve.qos).
         kw = {} if session_id is None else {"conv": str(session_id)}
+        if tenant is not None:
+            kw["tenant"] = str(tenant)
         for _ in range(max(1, int(max_attempts))):
             name = self.route(tokens, session_id)
             try:
@@ -567,14 +578,15 @@ def _rpc_dial(address: str, timeout: float) -> Any:
     from tony_tpu.rpc import RpcClient, RpcError
 
     class _Front:
-        def generate(self, tokens, max_new_tokens, rid=None, conv=None):
+        def generate(self, tokens, max_new_tokens, rid=None, conv=None,
+                     tenant=None):
             with RpcClient(address, timeout=timeout) as client:
                 return client.call("generate", tokens=tokens,
                                    max_new_tokens=max_new_tokens,
-                                   rid=rid, conv=conv)
+                                   rid=rid, conv=conv, tenant=tenant)
 
         def prefill_handoff(self, tokens, max_new_tokens, rid=None,
-                            decode=None, conv=None):
+                            decode=None, conv=None, tenant=None):
             # ``decode`` crosses the wire as an address — the prefill
             # REPLICA ships the fat KV payload replica-to-replica; the
             # router only orchestrates. A transported HandoffError
@@ -586,7 +598,7 @@ def _rpc_dial(address: str, timeout: float) -> Any:
                     return client.call("prefill_handoff", tokens=tokens,
                                        max_new_tokens=max_new_tokens,
                                        rid=rid, decode_address=decode,
-                                       conv=conv)
+                                       conv=conv, tenant=tenant)
             except RpcError as e:
                 if str(e).startswith("HandoffError:"):
                     raise HandoffError(str(e), retryable=False) from e
@@ -606,9 +618,10 @@ class RouterRpcHandler:
 
     def rpc_generate(self, tokens: List[int], max_new_tokens: int = 16,
                      rid: Optional[str] = None,
-                     session_id: Optional[str] = None) -> Dict[str, Any]:
+                     session_id: Optional[str] = None,
+                     tenant: Optional[str] = None) -> Dict[str, Any]:
         return self.router.dispatch(tokens, max_new_tokens, rid=rid,
-                                    session_id=session_id)
+                                    session_id=session_id, tenant=tenant)
 
     def rpc_router_stats(self) -> Dict[str, float]:
         return self.router.stats()

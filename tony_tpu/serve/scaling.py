@@ -23,12 +23,12 @@ fields ride along for observability and for future SLO-driven policies.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from tony_tpu.conf import (SERVE_COOLDOWN_S, SERVE_P99_HIGH_MS,
                            SERVE_QUEUE_HIGH, SERVE_QUEUE_LOW,
                            SERVE_REPLICAS_MAX, SERVE_REPLICAS_MIN,
-                           serve_replicas_max_key)
+                           SERVE_SLO_TARGET_MS, serve_replicas_max_key)
 
 
 def apportion_fleet_max(floors: Dict[str, int],
@@ -65,8 +65,17 @@ class ScalingPolicy:
     queue_low: float = 1.0
     p99_high_ms: float = 0.0
     cooldown_s: float = 30.0
+    # SLO mode (PR 18; 0 = off, the queue-depth matrix above verbatim):
+    # a non-zero p99 target switches the hot/cold verdicts to
+    # p99-vs-target — the gang scales on the USER-VISIBLE promise, from
+    # the same latency windows the history plane logs, so a replayed
+    # event log reproduces the live decisions exactly.
+    slo_target_ms: float = 0.0
 
     def __post_init__(self):
+        if self.slo_target_ms < 0:
+            raise ValueError(f"slo_target_ms must be >= 0, got "
+                             f"{self.slo_target_ms}")
         if self.min_replicas < 1:
             raise ValueError(f"min_replicas must be >= 1, got "
                              f"{self.min_replicas}")
@@ -109,6 +118,7 @@ class ScalingPolicy:
             queue_low=conf.get_float(SERVE_QUEUE_LOW, 1.0),
             p99_high_ms=conf.get_float(SERVE_P99_HIGH_MS, 0.0),
             cooldown_s=conf.get_float(SERVE_COOLDOWN_S, 30.0),
+            slo_target_ms=conf.get_float(SERVE_SLO_TARGET_MS, 0.0),
         )
 
     @property
@@ -128,10 +138,16 @@ def decide(policy: ScalingPolicy, n_running: int,
     * below the floor (replica lost / startup): grow toward
       ``min_replicas`` immediately — no cooldown, this is repair;
     * inside the cooldown window after any action: hold;
-    * mean queue depth above ``queue_high`` — or p99 above
-      ``p99_high_ms`` when enabled — and below the ceiling: +1;
-    * mean queue depth below ``queue_low``, p99 comfortably under the
-      high-water, and above the floor: −1.
+    * **queue-depth mode** (``slo_target_ms == 0`` — the historical
+      matrix, verbatim): mean queue depth above ``queue_high`` — or p99
+      above ``p99_high_ms`` when enabled — and below the ceiling: +1;
+      mean queue depth below ``queue_low``, p99 comfortably under the
+      high-water, and above the floor: −1;
+    * **SLO mode** (``slo_target_ms > 0``): the gang's worst p99 above
+      the target and below the ceiling: +1; p99 under HALF the target
+      AND mean queue depth under ``queue_low`` (latency headroom alone
+      is not idleness — an empty window also reads p99=0) and above the
+      floor: −1.
     """
     if n_running < policy.min_replicas:
         return policy.min_replicas - n_running
@@ -142,12 +158,16 @@ def decide(policy: ScalingPolicy, n_running: int,
     qd = sum(float(s.get("queue_depth", 0.0)) for s in samples) \
         / len(samples)
     p99 = max(float(s.get("p99_ms", 0.0)) for s in samples)
-    hot = qd > policy.queue_high or (
-        policy.p99_high_ms > 0 and p99 > policy.p99_high_ms)
+    if policy.slo_target_ms > 0:
+        hot = p99 > policy.slo_target_ms
+        cold = p99 < 0.5 * policy.slo_target_ms and qd < policy.queue_low
+    else:
+        hot = qd > policy.queue_high or (
+            policy.p99_high_ms > 0 and p99 > policy.p99_high_ms)
+        cold = qd < policy.queue_low and (
+            policy.p99_high_ms <= 0 or p99 < 0.5 * policy.p99_high_ms)
     if hot and n_running < policy.max_replicas:
         return 1
-    cold = qd < policy.queue_low and (
-        policy.p99_high_ms <= 0 or p99 < 0.5 * policy.p99_high_ms)
     if cold and n_running > policy.min_replicas:
         return -1
     return 0
@@ -169,3 +189,32 @@ def decide_warm(policy: ScalingPolicy, warm_target: int, n_active: int,
     want = max(0, min(int(warm_target),
                       policy.max_replicas - int(n_active)))
     return want - int(n_warm)
+
+
+def replay_decisions(records: Sequence[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """Replay a job's SCALE_DECISION event records through
+    :func:`decide` — the load-bearing-history acceptance check: each
+    record carries the COMPLETE decide() input (policy fields, active
+    count, samples, clock, last action) next to the delta the live AM
+    applied, so recomputing from the log must reproduce the live run
+    exactly (floats round-trip bit-exact through JSON).
+
+    ``records`` are the event payloads (``ev["payload"]`` of each
+    SCALE_DECISION). Returns one verdict dict per record:
+    ``{"job_type", "logged", "replayed", "match"}`` — ``tony history``
+    renders the column; a mismatch means the log stopped carrying the
+    decision's true inputs, which is exactly the regression this
+    guards."""
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        policy = ScalingPolicy(**rec["policy"])
+        replayed = decide(policy, int(rec["n_active"]),
+                          rec.get("samples") or [],
+                          now=float(rec["now"]),
+                          last_action=rec.get("last_action"))
+        logged = int(rec["delta"])
+        out.append({"job_type": rec.get("job_type", ""),
+                    "logged": logged, "replayed": replayed,
+                    "match": replayed == logged})
+    return out

@@ -638,6 +638,65 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
     return state, metrics
 
 
+def train_stats_writer(path: Optional[str] = None, *,
+                       flops_per_step: float = 0.0,
+                       peak_flops: float = 0.0
+                       ) -> Callable[[int, Dict[str, Any]], None]:
+    """An ``on_step`` callback for :func:`train_loop` that publishes
+    per-step cost telemetry — wall time, collective bytes (summed from
+    :func:`tony_tpu.profiler.collective_report`'s planned per-issue
+    payloads), and an MFU estimate (``flops_per_step / (step_time *
+    peak_flops)`` when both are given) — to the executor's stats file
+    through the atomic stage-and-rename idiom (tmp + ``os.replace``,
+    the serve engine's ``write_stats`` contract). The executor's
+    heartbeat loop piggybacks the file to the AM unchanged, where the
+    history plane logs each window as a TRAIN_STEP event: one writer,
+    one schema, no second bookkeeping path.
+
+    ``path`` defaults to the ``TONY_SERVE_STATS`` env the executor
+    injects into every task; outside a tony-run task (no env, no
+    explicit path) the callback is a no-op so scripts run unchanged."""
+    import json as json_mod
+    import time as time_mod
+
+    target = path or os.environ.get(constants.ENV_SERVE_STATS)
+    last = {"t": time_mod.monotonic()}
+
+    def on_step(step: int, metrics: Dict[str, Any]) -> None:
+        now = time_mod.monotonic()
+        dt = now - last["t"]
+        last["t"] = now
+        if not target:
+            return
+        nbytes = 0.0
+        try:
+            from tony_tpu import profiler
+            for rec in profiler.collective_report().values():
+                nbytes += float(sum(rec.get("nbytes") or ()))
+        except Exception:
+            pass                       # telemetry is advisory
+        mfu = (flops_per_step / (dt * peak_flops)
+               if flops_per_step > 0 and peak_flops > 0 and dt > 0
+               else 0.0)
+        payload = {"step": float(step), "step_time_s": float(dt),
+                   "collective_bytes": nbytes, "mfu": float(mfu)}
+        loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        if loss is not None:
+            try:
+                payload["loss"] = float(jax.device_get(loss))
+            except (TypeError, ValueError):
+                pass
+        tmp = f"{target}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json_mod.dump(payload, fh)
+            os.replace(tmp, target)
+        except OSError:
+            pass                       # advisory: never fail the step
+
+    return on_step
+
+
 def _validate_local_batch(mesh: Mesh, local_batch: Dict[str, Any],
                           seq_axis: bool = False) -> None:
     """Pre-flight the ``make_array_from_process_local_data`` contract and

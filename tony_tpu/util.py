@@ -86,20 +86,27 @@ def normalize_serve_telemetry(raw: Dict) -> Dict[str, object]:
     (the router's ``prefix_digest`` block-key list and the parked-
     conversation ``parked_digest`` list) become string lists, and
     non-numeric strings (the disaggregated replica ``role``
-    — the schema's second non-scalar) pass through as strings. Numeric
-    strings still normalize to float, so a stats writer that
-    stringified a counter keeps its historical behavior. Raises on
-    anything else (dicts, None), so both callers keep their own
-    advisory-telemetry failure handling."""
-    out: Dict[str, object] = {}
-    for k, v in dict(raw).items():
+    — the schema's second non-scalar) pass through as strings, and the
+    per-tenant ``tenants`` breakdown (tony_tpu.serve.qos — a dict of
+    per-tenant dicts of scalars, the schema's ONE sanctioned nesting)
+    normalizes recursively. Numeric strings still normalize to float,
+    so a stats writer that stringified a counter keeps its historical
+    behavior. Raises on anything else (deeper nesting, None), so both
+    callers keep their own advisory-telemetry failure handling."""
+    def norm(v: object, depth: int) -> object:
         if isinstance(v, (list, tuple)):
-            out[str(k)] = [str(x) for x in v]
-        elif isinstance(v, str):
+            return [str(x) for x in v]
+        if isinstance(v, dict):
+            if depth >= 3:
+                raise TypeError(
+                    "serve telemetry nests deeper than the schema's "
+                    "tenants breakdown (dict of dicts of scalars)")
+            return {str(k): norm(x, depth + 1) for k, x in v.items()}
+        if isinstance(v, str):
             try:
-                out[str(k)] = float(v)
+                return float(v)
             except ValueError:
-                out[str(k)] = v
-        else:
-            out[str(k)] = float(v)
-    return out
+                return v
+        return float(v)
+
+    return {str(k): norm(v, 1) for k, v in dict(raw).items()}
