@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-elastic tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-elastic
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -155,6 +155,18 @@ tier1-aot:
 # timeout, but this named leg is the lane's full gate (slow included).
 tier1-qos:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m qos -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Elastic-resize marker leg (tony_tpu.am.resize PR 19) — the resize
+# state machine's phase/timeout/degrade pins, the chaos-injection
+# harness, the drain→commit train-loop exit, the heartbeat-backoff
+# regression, the rotation crash sweep, and the headline pin: a run
+# with >=3 injected preemptions across changing host counts reproduces
+# the undisturbed run's example-id stream exactly with final params
+# within tolerance. The chaos/e2e segments are slow-marked to keep
+# tier1-verify inside its (tight — ROADMAP) 870 s budget, but this
+# named leg is the lane's full gate (slow included).
+tier1-elastic:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Source lints, machine-checked: (1) the jnp.concatenate/stack pack-site
 # lint (the jax-0.4 GSPMD concat-reshard footgun) — every call site

@@ -343,6 +343,21 @@ def main() -> int:
         except Exception as e:  # secondary metric must not sink the bench
             result["qos_error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(result), flush=True)
+
+    if os.environ.get("BENCH_RESIZE", "1") != "0":
+        # Elastic-resize leg (tony_tpu.am.resize, PR 19): the drain →
+        # commit → re-gang → restore lifecycle's data-plane walls — a
+        # run interrupted mid-schedule by a synchronous drain-commit
+        # and an elastic restore vs the same schedule undisturbed. The
+        # headline is resize_overhead_s (decomposed into commit +
+        # restore); the machine-independent claim is the bitwise
+        # final-state gate (resize_numerics_ok). BENCH_r19.
+        try:
+            from tony_tpu.benchmark import run_resize_bench
+            result.update(run_resize_bench(on_tpu=on_tpu))
+        except Exception as e:  # secondary metric must not sink the bench
+            result["resize_error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
     if on_tpu and os.environ.get("BENCH_LLM", "1") != "0":
         try:
             result.update(bench_llm(peak))

@@ -55,6 +55,7 @@ _TIER_BY_MODULE = {
     "test_kvtier": "jit",
     "test_aot": "jit",
     "test_qos": "jit",
+    "test_elastic": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

@@ -1,0 +1,15 @@
+"""Elastic-resize-aware standalone workload: runs until the executor's
+drain flag appears (the ``TONY_DRAIN_FILE`` path materialized when the
+AM's heartbeat reply carries the drain directive), then exits
+``EXIT_DRAINED`` — the minimal analogue of ``train_loop``'s drain poll
+for e2e resize tests that don't need a real model."""
+
+import os
+import sys
+import time
+
+drain = os.environ.get("TONY_DRAIN_FILE", "")
+while True:
+    if drain and os.path.exists(drain):
+        sys.exit(14)  # constants.EXIT_DRAINED
+    time.sleep(0.05)

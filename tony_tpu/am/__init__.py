@@ -32,6 +32,7 @@ from typing import Dict, Optional
 
 from tony_tpu import conf as conf_mod
 from tony_tpu import constants
+from tony_tpu.am import resize as resize_mod
 from tony_tpu.conf import TonyConfig
 from tony_tpu.events import EventHandler
 from tony_tpu.rpc import ENV_JOB_TOKEN, ApplicationRpcHandler, RpcServer
@@ -124,6 +125,13 @@ class ApplicationMaster:
         self.final_message = ""
         self.history_dir: Optional[Path] = None       # set in run()
         self._stop_reason: Optional[str] = None       # set by request_stop
+        # Elastic resize (tony_tpu.am.resize): one controller at a time,
+        # ticked from the monitor loop; it survives across attempts (the
+        # drain ends one attempt, re-gang/restore run in the next).
+        self._resize: Optional[resize_mod.ResizeController] = None
+        self._resize_count = 0
+        self._resize_relaunch = False
+        self._operator_resize: Optional[int] = None   # set from RPC thread
 
     def _log(self, msg: str) -> None:
         if not self.quiet:
@@ -229,6 +237,157 @@ class ApplicationMaster:
             if c is not None and c.is_running:
                 self.scheduler.stop_container(c)
 
+    # -- elastic resize ----------------------------------------------------
+    def _resize_job_type(self) -> str:
+        return self.conf.get(conf_mod.RESIZE_JOB_TYPE) or constants.WORKER
+
+    def _resize_enabled(self, job_type: str) -> bool:
+        return (self.conf.get_bool(conf_mod.RESIZE_ENABLED, False)
+                and job_type == self._resize_job_type())
+
+    def _resize_timeouts(self) -> resize_mod.ResizeTimeouts:
+        return resize_mod.ResizeTimeouts(
+            drain_s=self.conf.get_int(
+                conf_mod.RESIZE_DRAIN_TIMEOUT_MS, 60000) / 1e3,
+            regang_s=self.conf.get_int(
+                conf_mod.RESIZE_REGANG_TIMEOUT_MS, 120000) / 1e3,
+            restore_s=self.conf.get_int(
+                conf_mod.RESIZE_RESTORE_TIMEOUT_MS, 120000) / 1e3)
+
+    def _request_operator_resize(self, n: int) -> None:
+        """RPC-thread half of ``tony resize N``: record the ask only —
+        the monitor loop owns every session/scheduler mutation, so the
+        RPC thread must not trigger the resize itself."""
+        self._operator_resize = int(n)
+
+    def _emit_resize_phase(self, spec: resize_mod.ResizeSpec,
+                           phase: resize_mod.ResizePhase, wall_s: float,
+                           ok: bool, detail: str) -> None:
+        self._log(f"resize {phase.value}: "
+                  f"{'done' if ok else 'FAILED'} in {wall_s:.2f}s"
+                  + (f" ({detail})" if detail else ""))
+        if self.events is not None:
+            self.events.resize(phase.value, spec.trigger, spec.job_type,
+                               spec.old_workers, spec.new_workers,
+                               wall_s, ok, detail)
+
+    def _regang_poll(self) -> bool:
+        """RE-GANG completes when the NEW attempt's gang barrier seals
+        (the draining attempt's session is excluded by its drain flag)."""
+        s = self.session
+        return (s is not None and not s.draining
+                and self.handler is not None
+                and self.handler._all_registered_fired
+                and s.all_registered())
+
+    def _restore_poll(self) -> bool:
+        """RESTORING completes when every tracked task of the resized
+        jobtype is RUNNING and heartbeating on the new topology (restore
+        CORRECTNESS — element-identical stream, mesh-mapped params — is
+        the ckpt/data planes' pinned contract, not re-checked here)."""
+        s = self.session
+        if s is None or s.draining:
+            return False
+        jt = self._resize_job_type()
+        gang = [t for t in s.tasks() if t.job_type == jt and t.tracked]
+        return bool(gang) and all(
+            t.status == TaskStatus.RUNNING and t.last_heartbeat is not None
+            for t in gang)
+
+    def _trigger_resize(self, session: TonySession, trigger: str,
+                        job_type: str, new_workers: int) -> bool:
+        """Begin a resize (drain phase starts immediately). False means
+        this churn must fall back to the pre-elastic recovery path
+        (resize disabled, wrong jobtype, or the resize budget is spent);
+        True with a resize already in flight folds the churn into it."""
+        if not self._resize_enabled(job_type):
+            return False
+        if self._resize is not None and self._resize.active:
+            return True
+        max_resizes = self.conf.get_int(conf_mod.RESIZE_MAX_RESIZES, 8)
+        if self._resize_count >= max_resizes:
+            self._log(f"resize budget exhausted "
+                      f"({self._resize_count}/{max_resizes}); "
+                      f"falling back to gang restart")
+            return False
+        floor = max(1, self.conf.get_int(conf_mod.RESIZE_MIN_WORKERS, 1))
+        target = max(int(new_workers), floor)
+        spec = resize_mod.ResizeSpec(
+            trigger=trigger, job_type=job_type,
+            old_workers=self.conf.instances(job_type),
+            new_workers=target)
+        controller = resize_mod.ResizeController(
+            poll={
+                resize_mod.ResizePhase.DRAINING:
+                    lambda: (self.session is not None
+                             and self.session.drain_complete(job_type)),
+                resize_mod.ResizePhase.REGANG: self._regang_poll,
+                resize_mod.ResizePhase.RESTORING: self._restore_poll,
+            },
+            enter={resize_mod.ResizePhase.DRAINING: session.request_drain},
+            timeouts=self._resize_timeouts(),
+            on_phase=self._emit_resize_phase)
+        self._resize = controller
+        self._resize_count += 1
+        self._log(f"resize #{self._resize_count} ({trigger}): "
+                  f"{spec.old_workers} -> {target} {job_type}(s); draining")
+        controller.start(spec)
+        return True
+
+    def _divert_to_resize(self, session: TonySession, task,
+                          trigger: str, reason: str) -> bool:
+        """Route one task's churn (preemption / lost heartbeat) into the
+        resize machine instead of the same-index retry or the fail-fast
+        LOST verdict. The churned task goes terminal WITHOUT failing the
+        job (mark_scaled_down); survivors drain at the next heartbeat."""
+        if not self._resize_enabled(task.job_type):
+            return False
+        if self._resize is None or not self._resize.active:
+            live = [t for t in session.tasks()
+                    if t.job_type == task.job_type and t.tracked
+                    and not t.status.is_terminal and t is not task]
+            if not self._trigger_resize(session, trigger, task.job_type,
+                                        len(live)):
+                return False
+        session.mark_scaled_down(task, reason)
+        c = self._containers.get(task.task_id)
+        if c is not None and c.is_running:
+            self.scheduler.stop_container(c)
+        return True
+
+    def _tick_resize(self, session: TonySession) -> None:
+        """One monitor-loop observation of the in-flight resize. Ends the
+        DRAINING attempt when the commit is durable (run() then re-gangs
+        at the new size), and on a terminal verdict either celebrates or
+        degrades the job to the full-gang-restart path."""
+        c = self._resize
+        if c is None or not c.active:
+            return
+        result = c.tick()
+        if result is None:
+            if session.draining \
+                    and c.phase is not resize_mod.ResizePhase.DRAINING:
+                # Drain committed: end this attempt so run() can apply
+                # the new topology and relaunch (re-gang).
+                self._resize_relaunch = True
+            return
+        self._resize = None
+        spec = result.spec
+        if result.ok:
+            walls = ", ".join(f"{k} {v:.2f}s"
+                              for k, v in result.phase_walls.items())
+            self._log(f"resize complete: {spec.old_workers} -> "
+                      f"{spec.new_workers} {spec.job_type}(s) ({walls})")
+            return
+        # Degrade: never a hang, never a torn checkpoint — the gang
+        # restart's restore-from-last-commit owns recovery from here.
+        self._log(f"resize degraded ({result.reason}); full gang restart")
+        session.clear_drain()
+        with session.lock:
+            if session.job_status == JobStatus.RUNNING:
+                session.job_status = JobStatus.FAILED
+                session.final_message = f"resize degraded: {result.reason}"
+
     # -- monitor-loop checks ----------------------------------------------
     def _check_heartbeats(self, session: TonySession) -> None:
         interval_s = self.conf.get_int(
@@ -249,6 +408,13 @@ class ApplicationMaster:
             if task.status in watched \
                     and task.last_heartbeat \
                     and now - task.last_heartbeat > expiry:
+                if self._divert_to_resize(
+                        session, task, "lost",
+                        f"missed {max_missed} heartbeats; "
+                        f"elastic resize in place of LOST"):
+                    self._log(f"task {task.task_id} missed {max_missed} "
+                              f"heartbeats -> elastic resize")
+                    continue
                 self._log(f"task {task.task_id} missed {max_missed} "
                           f"heartbeats -> LOST")
                 session.on_task_lost(
@@ -270,6 +436,11 @@ class ApplicationMaster:
             if task.status.is_terminal:
                 continue
             if c.exit_code == constants.EXIT_PREEMPTED:
+                if self._divert_to_resize(
+                        session, task, "preempted",
+                        "preempted; elastic resize in place of retry"):
+                    self._log(f"{task.task_id} preempted -> elastic resize")
+                    continue
                 task.preemption_retries += 1
                 if task.preemption_retries <= max_preempt:
                     self._log(f"{task.task_id} preempted "
@@ -579,6 +750,8 @@ class ApplicationMaster:
 
         handler.on_all_registered = on_all_registered
         handler.on_callback_info = am_adapter.receive_task_callback_info
+        if conf.get_bool(conf_mod.RESIZE_ENABLED, False):
+            handler.on_resize = self._request_operator_resize
         if self.events is not None:
             handler.on_registered = (
                 lambda jt, i: self.events.task_started(
@@ -626,6 +799,12 @@ class ApplicationMaster:
 
                 self._handle_completed_containers(session)
                 self._check_heartbeats(session)
+                if self._operator_resize is not None:
+                    n, self._operator_resize = self._operator_resize, None
+                    jt = self._resize_job_type()
+                    if not self._trigger_resize(session, "operator", jt, n):
+                        self._log(f"operator resize to {n} refused")
+                self._tick_resize(session)
                 self._log_history_events(session)
                 self._autoscale_serve(session)
                 self._maybe_refresh_credentials()
@@ -658,6 +837,11 @@ class ApplicationMaster:
                                 f"application exceeded "
                                 f"tony.application.timeout-ms")
                 if session.is_done():
+                    break
+                if self._resize_relaunch:
+                    # Drained gang committed; the attempt ends here and
+                    # run() relaunches at the new size (normal teardown
+                    # below reaps the already-exited containers).
                     break
                 time.sleep(_TICK_S)
         finally:
@@ -698,20 +882,53 @@ class ApplicationMaster:
         retries = conf.get_int(conf_mod.AM_RETRY_COUNT, 0)
         status = JobStatus.FAILED
         try:
-            for attempt in range(1, retries + 2):
+            attempt = 1
+            retries_used = 0
+            while True:
                 status = self.run_attempt(attempt)
+                if self._resize_relaunch and self._resize is not None \
+                        and self._resize.active:
+                    # Elastic re-gang: the drained gang's commit is
+                    # durable, so apply the new topology and relaunch —
+                    # WITHOUT consuming the gang-restart retry budget
+                    # (resizes have their own: tony.resize.max-resizes).
+                    self._resize_relaunch = False
+                    spec = self._resize.spec
+                    conf.set(conf_mod.instances_key(spec.job_type),
+                             str(spec.new_workers))
+                    conf.save(self.job_dir / constants.TONY_JOB_JSON)
+                    ckpt_step = (self.session.last_committed_step()
+                                 if self.session else None)
+                    self._log(
+                        f"resize re-gang: relaunching "
+                        f"{spec.new_workers} {spec.job_type}(s)"
+                        + (f"; resuming from committed ckpt step "
+                           f"{ckpt_step}" if ckpt_step is not None
+                           else ""))
+                    attempt += 1
+                    continue
                 if status in (JobStatus.SUCCEEDED, JobStatus.KILLED):
                     break
-                if attempt <= retries:
+                if retries_used < retries:
+                    retries_used += 1
                     ckpt_step = (self.session.last_committed_step()
                                  if self.session else None)
                     self._log(
                         f"attempt {attempt} failed; gang restart "
-                        f"({attempt}/{retries} retries used)"
+                        f"({retries_used}/{retries} retries used)"
                         + (f"; resuming from committed ckpt step "
                            f"{ckpt_step}" if ckpt_step is not None
                            else ""))
+                    attempt += 1
+                    continue
+                break
         finally:
+            if self._resize is not None and self._resize.active:
+                # A terminal AM must never leave a phase dangling — the
+                # degrade verdict (and its RESIZE record) lands before
+                # the event log closes.
+                self._resize.abandon("application finished")
+                self._resize = None
             self.final_status = status
             self.final_message = (self.session.final_message
                                   if self.session else "")

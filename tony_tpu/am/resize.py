@@ -1,0 +1,247 @@
+"""Elastic gang resize: the drain → re-gang → restore state machine.
+
+On a worker preemption / lost heartbeat (or an operator ``tony resize
+N``), the AM stops answering churn with the most expensive recovery it
+has (the full gang restart of ``tony.am.retry-count``) and instead
+walks the gang through
+
+    RUNNING → DRAINING → RE-GANG → RESTORING → RUNNING
+
+* **DRAINING** — survivors are told to stop at the next step boundary
+  (the drain directive rides the heartbeat *response*; the executor
+  materializes it as a drain file the train loop polls). Each survivor
+  commits model + data cursor through the PR 3 atomic manifest and
+  exits ``EXIT_DRAINED`` — a clean, non-failing terminal.
+* **RE-GANG** — the AM rewrites the gang's instance count, re-saves the
+  job config, and relaunches at the new host count through the normal
+  launch machinery; healthy containers' allocations/workdirs are reused
+  (``jax.distributed`` cannot re-negotiate membership in-process, so
+  the worker *processes* restart regardless — the savings is the
+  container setup, not the process).
+* **RESTORING** — the relaunched gang restores elastically: the PR 3
+  manifest maps onto the changed mesh, the PR 4 cursor continues the
+  global example stream element-identically, and the PR 17 AOT cache's
+  mesh-keyed fingerprint makes a previously-seen geometry pay zero
+  recompile.
+
+This module is the *pure* half: :class:`ResizeController` owns phase
+order, per-phase deadlines, wall-clock accounting, and the degrade
+verdict, while the AM injects the live predicates (``poll``) and phase
+entry actions (``enter``). The controller is tick-driven from the AM
+monitor loop — it never blocks, so a wedged phase can only *time out*
+(degrading to the full gang restart), never hang. Unit tests drive
+``tick()`` with a fake clock and pin exactly that.
+
+Failures are typed: :class:`ResizeError` carries the phase and a
+``retryable`` flag. A drain that cannot complete is NOT retryable as a
+resize (the surviving checkpoint may predate the drain request — only
+the gang restart's restore-from-last-commit is safe); re-gang/restore
+failures are retryable (the next preemption or operator verb may try
+again) but still degrade this resize to the restart path.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, Mapping, Optional
+
+__all__ = ["ResizePhase", "ResizeError", "ResizeSpec", "ResizeTimeouts",
+           "ResizeResult", "ResizeController"]
+
+
+class ResizePhase(Enum):
+    DRAINING = "DRAINING"
+    REGANG = "RE-GANG"
+    RESTORING = "RESTORING"
+
+
+class ResizeError(RuntimeError):
+    """A resize phase failed. ``retryable`` says whether a LATER resize
+    attempt is sound (re-gang/restore hiccups) or whether only the full
+    gang restart is (drain never finished — the last commit may predate
+    the drain request). Either way THIS resize degrades."""
+
+    def __init__(self, phase: ResizePhase, message: str, *,
+                 retryable: bool):
+        super().__init__(f"{phase.value}: {message}")
+        self.phase = phase
+        self.retryable = retryable
+
+
+@dataclass(frozen=True)
+class ResizeSpec:
+    """One resize's intent: what triggered it and the topology change."""
+    trigger: str                 # "preempted" | "lost" | "operator"
+    job_type: str
+    old_workers: int
+    new_workers: int
+
+
+@dataclass(frozen=True)
+class ResizeTimeouts:
+    """Per-phase wall budgets (seconds). Every phase is bounded — the
+    never-hang guarantee is these three numbers plus the tick loop."""
+    drain_s: float = 60.0
+    regang_s: float = 120.0
+    restore_s: float = 120.0
+
+    def budget(self, phase: ResizePhase) -> float:
+        return {ResizePhase.DRAINING: self.drain_s,
+                ResizePhase.REGANG: self.regang_s,
+                ResizePhase.RESTORING: self.restore_s}[phase]
+
+
+@dataclass
+class ResizeResult:
+    """Terminal verdict of one resize attempt. ``degraded`` means the
+    caller must fall back to the full gang restart; ``phase_walls``
+    carries per-phase wall seconds for the RESIZE history records."""
+    ok: bool
+    spec: ResizeSpec
+    degraded: bool = False
+    failed_phase: Optional[ResizePhase] = None
+    retryable: bool = True
+    reason: str = ""
+    phase_walls: Dict[str, float] = field(default_factory=dict)
+
+
+# Signature of the per-phase observer: (spec, phase, wall_s, ok, detail).
+PhaseObserver = Callable[[ResizeSpec, ResizePhase, float, bool, str], None]
+
+
+class ResizeController:
+    """Tick-driven resize machine.
+
+    ``poll`` maps each phase to a zero-arg completion predicate (True =
+    phase done); ``enter`` optionally maps a phase to a zero-arg entry
+    action fired once when the phase begins. Both run on the caller's
+    thread (the AM monitor loop). A predicate/entry raising is treated
+    as that phase failing (wrapped in :class:`ResizeError` unless it
+    already is one).
+
+    Drive with :meth:`start` then :meth:`tick` until a
+    :class:`ResizeResult` comes back; ``on_phase`` (when given) observes
+    every phase completion/failure — the AM points it at the RESIZE
+    event emitter so recovery timelines land in the history plane.
+    """
+
+    _ORDER = (ResizePhase.DRAINING, ResizePhase.REGANG,
+              ResizePhase.RESTORING)
+
+    def __init__(self, *,
+                 poll: Mapping[ResizePhase, Callable[[], bool]],
+                 enter: Optional[Mapping[ResizePhase,
+                                         Callable[[], None]]] = None,
+                 timeouts: Optional[ResizeTimeouts] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_phase: Optional[PhaseObserver] = None):
+        missing = [p.value for p in self._ORDER if p not in poll]
+        if missing:
+            raise ValueError(f"resize poll map is missing phases "
+                             f"{missing}")
+        self._poll = dict(poll)
+        self._enter = dict(enter or {})
+        self.timeouts = timeouts or ResizeTimeouts()
+        self._clock = clock
+        self._on_phase = on_phase
+        self.spec: Optional[ResizeSpec] = None
+        self.phase: Optional[ResizePhase] = None
+        self._phase_t0 = 0.0
+        self._walls: Dict[str, float] = {}
+
+    @property
+    def active(self) -> bool:
+        return self.spec is not None
+
+    def start(self, spec: ResizeSpec) -> None:
+        if self.active:
+            raise ResizeError(self.phase or ResizePhase.DRAINING,
+                              "a resize is already in flight",
+                              retryable=True)
+        if spec.new_workers < 1:
+            raise ValueError(
+                f"resize to {spec.new_workers} workers: a gang needs at "
+                f"least 1")
+        self.spec = spec
+        self._walls = {}
+        self._begin(self._ORDER[0])
+
+    def _begin(self, phase: ResizePhase) -> None:
+        self.phase = phase
+        self._phase_t0 = self._clock()
+        entry = self._enter.get(phase)
+        if entry is not None:
+            entry()
+
+    def _observe(self, phase: ResizePhase, wall: float, ok: bool,
+                 detail: str) -> None:
+        if self._on_phase is not None:
+            self._on_phase(self.spec, phase, wall, ok, detail)
+
+    def _fail(self, err: ResizeError) -> ResizeResult:
+        spec, phase = self.spec, self.phase
+        wall = self._clock() - self._phase_t0
+        self._walls[phase.value] = wall
+        self._observe(phase, wall, False, str(err))
+        result = ResizeResult(ok=False, spec=spec, degraded=True,
+                              failed_phase=phase,
+                              retryable=err.retryable, reason=str(err),
+                              phase_walls=dict(self._walls))
+        self.spec = None
+        self.phase = None
+        return result
+
+    def tick(self) -> Optional[ResizeResult]:
+        """Advance the machine one observation; returns the terminal
+        :class:`ResizeResult` when the resize completes or degrades,
+        ``None`` while a phase is still in flight. Bounded: a phase
+        whose predicate never turns true fails at its deadline."""
+        if not self.active:
+            return None
+        phase = self.phase
+        try:
+            done = bool(self._poll[phase]())
+        except ResizeError as e:
+            return self._fail(e)
+        except Exception as e:  # predicate blew up: that phase failed
+            return self._fail(ResizeError(
+                phase, f"phase check raised {type(e).__name__}: {e}",
+                retryable=phase is not ResizePhase.DRAINING))
+        now = self._clock()
+        if not done:
+            if now - self._phase_t0 > self.timeouts.budget(phase):
+                return self._fail(ResizeError(
+                    phase,
+                    f"timed out after {self.timeouts.budget(phase):.1f}s",
+                    retryable=phase is not ResizePhase.DRAINING))
+            return None
+        wall = now - self._phase_t0
+        self._walls[phase.value] = wall
+        self._observe(phase, wall, True, "")
+        idx = self._ORDER.index(phase)
+        if idx + 1 < len(self._ORDER):
+            try:
+                self._begin(self._ORDER[idx + 1])
+            except ResizeError as e:
+                return self._fail(e)
+            except Exception as e:
+                return self._fail(ResizeError(
+                    self._ORDER[idx + 1],
+                    f"phase entry raised {type(e).__name__}: {e}",
+                    retryable=True))
+            return None
+        result = ResizeResult(ok=True, spec=self.spec,
+                              phase_walls=dict(self._walls))
+        self.spec = None
+        self.phase = None
+        return result
+
+    def abandon(self, reason: str) -> Optional[ResizeResult]:
+        """Force-degrade an in-flight resize (e.g. the AM is shutting
+        down): terminal result now, never a dangling phase."""
+        if not self.active:
+            return None
+        return self._fail(ResizeError(self.phase, f"abandoned: {reason}",
+                                      retryable=True))

@@ -2215,6 +2215,122 @@ def run_coldstart_bench(*, seed: int = 0,
     return out
 
 
+def run_resize_bench(*, hidden: int = 1024, steps: int = 24,
+                     resize_at: int = 12,
+                     directory: str | None = None,
+                     on_tpu: bool | None = None) -> dict:
+    """Elastic-resize leg (tony_tpu.am.resize, PR 19): what a drain →
+    commit → re-gang → restore cycle costs the training timeline, and
+    whether it costs the MODEL anything. Two runs over the same batch
+    schedule:
+
+    * **undisturbed reference** — ``steps`` optimizer steps straight
+      through;
+    * **elastic run** — the same schedule interrupted at ``resize_at``
+      by the resize lifecycle's data plane: a synchronous drain-commit
+      (the train loop's EXIT_DRAINED contract — save + wait so the
+      manifest is durable before the worker reports drained), then an
+      elastic restore of the committed step (the re-gang survivor's
+      first act on the new topology), then the remaining steps from the
+      restored state.
+
+    The headline is ``resize_overhead_s`` (elastic wall − undisturbed
+    wall) decomposed into ``drain_commit_s`` + ``restore_s``; ROOFLINE
+    §15 prices the same walls against checkpoint size and host I/O. The
+    machine-independent claim is ``resize_numerics_ok``: the elastic
+    run's final state is BITWISE the undisturbed run's — a resize that
+    moves the loss curve is a restart, not a resize (tests/
+    test_elastic.py pins the example-id stream and multi-preemption
+    composition on top)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+    import optax
+
+    from tony_tpu import ckpt as ckpt_mod
+    from tony_tpu import parallel as par
+    from tony_tpu import train as tr
+    from tony_tpu.models import get_model
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    mesh = par.make_mesh(fsdp=1)
+    batch = 8
+    model = get_model("mnist-mlp", hidden=hidden)
+    kx, ky, kr = jax.random.split(jax.random.PRNGKey(0), 3)
+    xs = jax.random.normal(kx, (steps, batch, 784), jnp.float32)
+    ys = jax.random.randint(ky, (steps, batch), 0, 10)
+    state0 = tr.create_train_state(model, optax.sgd(0.1, momentum=0.9),
+                                   xs[0], kr)
+    step = tr.make_train_step(mesh=mesh, donate=False)
+    _ = step(state0, {"x": xs[0], "y": ys[0]})      # warm the compile
+
+    def run_steps(state, lo: int, hi: int):
+        for i in range(lo, hi):
+            state, _ = step(state, {"x": xs[i], "y": ys[i]})
+        jax.block_until_ready(state.params)
+        return state
+
+    root = Path(directory) if directory else Path(tempfile.mkdtemp(
+        prefix="tony-resize-bench-"))
+    try:
+        t0 = time.perf_counter()
+        ref = run_steps(state0, 0, steps)
+        undisturbed_s = time.perf_counter() - t0
+
+        ck = ckpt_mod.AsyncCheckpointer(root / "resize", keep=2)
+        t0 = time.perf_counter()
+        state = run_steps(state0, 0, resize_at)
+        t1 = time.perf_counter()
+        ck.save(state, step=resize_at, block=True)  # the drain commit
+        t2 = time.perf_counter()
+        abstract = jax.tree.map(
+            lambda a: np.zeros(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, jax.device_get(state))
+        restored = ckpt_mod.restore_pytree(root / "resize", abstract,
+                                           mesh=mesh)
+        t3 = time.perf_counter()
+        final = run_steps(restored, resize_at, steps)
+        elastic_s = time.perf_counter() - t0
+        nbytes = ck.stats["nbytes"]
+        ck.close()
+
+        exact = all(
+            np.array_equal(np.asarray(jax.device_get(a)),
+                           np.asarray(jax.device_get(b)))
+            for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(ref))
+            if hasattr(b, "shape"))
+    finally:
+        if not directory:
+            shutil.rmtree(root, ignore_errors=True)
+    out = {
+        "metric": "resize_bench",
+        "resize_steps": steps,
+        "resize_at": resize_at,
+        "resize_state_mb": round(nbytes / (1024 * 1024), 3),
+        "resize_undisturbed_s": round(undisturbed_s, 6),
+        "resize_elastic_s": round(elastic_s, 6),
+        "resize_overhead_s": round(elastic_s - undisturbed_s, 6),
+        "resize_drain_commit_s": round(t2 - t1, 6),
+        "resize_restore_s": round(t3 - t2, 6),
+        "resize_numerics_ok": bool(exact),
+        "backend": jax.default_backend(),
+    }
+    if not on_tpu:
+        out["resize_sim_note"] = (
+            "CPU simulation: the walls price the lifecycle's DATA plane "
+            "(drain-commit + elastic restore) in one process — the "
+            "container re-grant and gang re-negotiation between them "
+            "are scheduler walls the MiniPod e2e measures, and tmpfs "
+            "I/O understates a real host's commit/restore cost "
+            "(ROOFLINE §15 prices both). The claim that transfers: "
+            "resize_numerics_ok — the interrupted run's final state is "
+            "bitwise the undisturbed run's")
+    return out
+
+
 def run_qos_bench(*, n_victim: int | None = None,
                   n_aggressor: int | None = None, seed: int = 0,
                   on_tpu: bool | None = None) -> dict:

@@ -219,11 +219,32 @@ def cmd_serve(args: argparse.Namespace) -> int:
                              "is per tenant class; without a spec it "
                              "would be silently ignored)")
         cfg.set(conf_mod.SERVE_QOS_MAX_QUEUE, str(args.qos_max_queue))
-    if args.slo_target_ms < 0:
-        raise SystemExit(f"--slo_target_ms must be >= 0, got "
-                         f"{args.slo_target_ms}")
     if args.slo_target_ms:
-        cfg.set(conf_mod.SERVE_SLO_TARGET_MS, str(args.slo_target_ms))
+        # Two grammars, one flag: a bare number is the fleet-wide target
+        # (the PR 18 lane, byte-identical behavior), while a tenant CSV
+        # (gold:200,silver:800) sets PER-TENANT targets — the autoscaler
+        # then scales on the worst tenant's p99-vs-target. Same strict
+        # parser as --tenants: a typo'd spec must die at submit, not
+        # silently autoscale on the wrong signal.
+        try:
+            target = float(args.slo_target_ms)
+        except ValueError:
+            from tony_tpu.serve.qos import parse_tenants
+
+            try:
+                targets = parse_tenants(args.slo_target_ms)
+            except ValueError as e:
+                raise SystemExit(f"--slo_target_ms: {e}")
+            if any(v <= 0 for v in targets.values()):
+                raise SystemExit("--slo_target_ms: per-tenant targets "
+                                 "must be > 0 ms")
+            cfg.set(conf_mod.SERVE_SLO_TARGETS, args.slo_target_ms)
+        else:
+            if target < 0:
+                raise SystemExit(f"--slo_target_ms must be >= 0, got "
+                                 f"{target}")
+            if target:
+                cfg.set(conf_mod.SERVE_SLO_TARGET_MS, str(target))
     if args.prefix_cache:
         cfg.set(conf_mod.SERVE_PREFIX_CACHE, "true")
     if args.prefill_chunk:
@@ -378,6 +399,34 @@ def cmd_kill(args: argparse.Namespace) -> int:
         print(f"kill RPC failed: {e}")
         return 1
     print(f"kill requested for {args.app_id}")
+    return 0
+
+
+def cmd_resize(args: argparse.Namespace) -> int:
+    """Operator-triggered elastic resize of a RUNNING job's training
+    gang: the AM drains the gang (each survivor commits model + data
+    cursor), re-gangs at the new worker count, and restores — the
+    ``tony_tpu.am.resize`` state machine. Needs the job submitted with
+    ``tony.resize.enabled=true``; a disabled job reports the refusal
+    here instead of silently ignoring the verb."""
+    from tony_tpu.rpc import RpcClient, RpcError
+
+    if args.num_workers < 1:
+        print(f"--num_workers must be >= 1, got {args.num_workers}")
+        return 1
+    live = _live_am(args)
+    if live is None:
+        return 1
+    _, addr, token = live
+    try:
+        with RpcClient(addr, token=token, timeout=10.0) as c:
+            c.call("resize", num_workers=args.num_workers)
+    except (RpcError, OSError) as e:
+        print(f"resize RPC failed: {e}")
+        return 1
+    print(f"resize to {args.num_workers} worker(s) requested for "
+          f"{args.app_id} (drain -> commit -> re-gang -> restore; "
+          f"follow with: tony history show {args.app_id})")
     return 0
 
 
@@ -546,11 +595,16 @@ def make_parser() -> argparse.ArgumentParser:
                     help="per-tenant queue cap: past it a tenant's "
                          "submits get typed retryable back-pressure "
                          "(0 = unbounded; needs --tenants)")
-    sv.add_argument("--slo_target_ms", type=float, default=0.0,
+    sv.add_argument("--slo_target_ms", default="",
+                    metavar="MS|TENANT:MS[,TENANT:MS...]",
                     help="p99 latency target arming SLO-mode "
                          "autoscaling: the gang scales on p99-vs-target "
                          "from the heartbeat latency windows the "
-                         "history plane logs (0 = queue-depth mode)")
+                         "history plane logs (0/empty = queue-depth "
+                         "mode); a tenant CSV like gold:200,silver:800 "
+                         "sets PER-TENANT targets and the gang scales "
+                         "on the worst tenant's p99 (needs the replicas "
+                         "publishing per-tenant windows via --tenants)")
     sv.add_argument("--spec_k", type=int, default=0,
                     help="speculative decoding draft depth (0 = off; "
                          "k tokens drafted, verified in ONE target "
@@ -592,9 +646,12 @@ def make_parser() -> argparse.ArgumentParser:
     rt.set_defaults(fn=cmd_route)
 
     h = sub.add_parser("history", help="list jobs or show one job's events")
-    h.add_argument("action", choices=["list", "show", "serve"],
-                   help="list all jobs / show one job / serve the web portal")
-    h.add_argument("app_id", nargs="?", help="application id (for show)")
+    h.add_argument("action", choices=["list", "show", "serve", "bill"],
+                   help="list all jobs / show one job / serve the web "
+                        "portal / roll up a tenant's billed tokens")
+    h.add_argument("app_id", nargs="?",
+                   help="application id (for show) or tenant name (for "
+                        "bill; omit to bill every tenant)")
     h.add_argument("--history", dest="history_dir",
                    help="history root dir (default: scan client workdir)")
     h.add_argument("--port", type=int, default=19885,
@@ -638,6 +695,15 @@ def make_parser() -> argparse.ArgumentParser:
     k.add_argument("--workdir", help="client work dir (default ~/.tony-tpu/jobs)")
     k.add_argument("--reason", help="recorded in the job's final message")
     k.set_defaults(fn=cmd_kill)
+
+    rz = sub.add_parser("resize", help="elastically resize a running "
+                        "job's training gang (drain -> commit -> "
+                        "re-gang -> restore)")
+    rz.add_argument("num_workers", type=int,
+                    help="target worker count after the resize")
+    rz.add_argument("app_id", help="application id of a RUNNING job")
+    rz.add_argument("--workdir", help="client work dir (default ~/.tony-tpu/jobs)")
+    rz.set_defaults(fn=cmd_resize)
 
     lg = sub.add_parser("logs", help="print per-container logs "
                         "(yarn logs analogue, local substrate)")

@@ -180,6 +180,25 @@ SERVE_DEMOTE_BATCH = "tony.serve.demote-batch"  # blocks/sweep (0=nb_max)
 SERVE_QOS_TENANTS = "tony.serve.qos.tenants"    # "name:weight,.." ("" = off)
 SERVE_QOS_MAX_QUEUE = "tony.serve.qos.max-queue"  # per-tenant cap (0 = inf)
 SERVE_SLO_TARGET_MS = "tony.serve.scale.slo-target-ms"  # p99 target (0=off)
+# Per-tenant p99 targets ("gold:200,silver:800", same grammar as the QoS
+# tenants CSV): SLO mode scales on the WORST tenant's p99-vs-target,
+# read from the tenants breakdown riding every SERVE_WINDOW record.
+# Composes with the single gang-wide target; "" = per-tenant mode off.
+SERVE_SLO_TARGETS = "tony.serve.scale.slo-targets"
+
+# Elastic gang resize (tony_tpu.am.resize): on worker preemption / lost
+# heartbeat (or `tony resize N`), drain survivors through an atomic
+# commit, re-gang at the new host count, and restore elastically —
+# instead of the full gang restart. Off by default: the historical
+# preemption-retry + gang-restart behavior is byte-unchanged unless
+# armed.
+RESIZE_ENABLED = "tony.resize.enabled"
+RESIZE_JOB_TYPE = "tony.resize.job-type"            # the elastic train gang
+RESIZE_MIN_WORKERS = "tony.resize.min-workers"      # floor after shrink
+RESIZE_MAX_RESIZES = "tony.resize.max-resizes"      # per-job resize budget
+RESIZE_DRAIN_TIMEOUT_MS = "tony.resize.drain-timeout-ms"
+RESIZE_REGANG_TIMEOUT_MS = "tony.resize.regang-timeout-ms"
+RESIZE_RESTORE_TIMEOUT_MS = "tony.resize.restore-timeout-ms"
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)
@@ -254,6 +273,13 @@ DEFAULTS: Dict[str, str] = {
     AM_GANG_TIMEOUT_MS: "120000",
     PREEMPTION_MAX_RETRIES: "3",
     HISTORY_LOCATION: "",
+    RESIZE_ENABLED: "false",
+    RESIZE_JOB_TYPE: constants.WORKER,
+    RESIZE_MIN_WORKERS: "1",
+    RESIZE_MAX_RESIZES: "8",
+    RESIZE_DRAIN_TIMEOUT_MS: "60000",
+    RESIZE_REGANG_TIMEOUT_MS: "120000",
+    RESIZE_RESTORE_TIMEOUT_MS: "120000",
 }
 
 

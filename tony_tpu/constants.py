@@ -56,6 +56,11 @@ ENV_DATA_SEED = "TONY_DATA_SEED"
 # there and the executor's heartbeat loop piggybacks it to the AM (both
 # sides jax-free), where the replica autoscaler reads it.
 ENV_SERVE_STATS = "TONY_SERVE_STATS"
+# Elastic resize (tony_tpu.am.resize): the executor exports a drain-file
+# path; when the AM's heartbeat response carries the drain directive the
+# executor creates the file, and train_loop — polling it between steps —
+# commits model+data-cursor and exits EXIT_DRAINED.
+ENV_DRAIN_FILE = "TONY_DRAIN_FILE"
 
 # TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
 ENV_TF_CONFIG = "TF_CONFIG"
@@ -143,3 +148,4 @@ EXIT_AM_ERROR = 10          # AM internal error
 EXIT_LOST_TASK = 11         # task lost to missed heartbeats
 EXIT_PREEMPTED = 12         # container preempted by the scheduler
 EXIT_KILLED = 13            # killed by client / untracked-task teardown
+EXIT_DRAINED = 14           # clean drain exit (elastic resize commit)

@@ -49,7 +49,7 @@ import time
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional
 
-from tony_tpu import constants
+from tony_tpu import chaos, constants
 
 APPLICATION_INITED = "APPLICATION_INITED"
 TASK_STARTED = "TASK_STARTED"
@@ -60,12 +60,14 @@ APPLICATION_FINISHED = "APPLICATION_FINISHED"
 SERVE_WINDOW = "SERVE_WINDOW"
 TRAIN_STEP = "TRAIN_STEP"
 SCALE_DECISION = "SCALE_DECISION"
+RESIZE = "RESIZE"
 
 _METADATA = "METADATA"
 
 # Record types a long run emits continuously (one per task heartbeat /
-# train step): rotation's compaction victims. Lifecycle events and
-# SCALE_DECISION (low-rate, replay-bearing) always survive whole.
+# train step): rotation's compaction victims. Lifecycle events,
+# SCALE_DECISION (low-rate, replay-bearing) and RESIZE (a handful per
+# job, the recovery timeline) always survive whole.
 _HIGH_RATE = frozenset({TASK_METRICS, SERVE_WINDOW, TRAIN_STEP})
 
 
@@ -133,13 +135,20 @@ class EventHandler:
         high = [r for r in records if r.get("type") in _HIGH_RATE]
         keep += high[len(high) // 2:]
         keep.sort(key=lambda r: r.get("timestamp", 0.0))
+        # Chaos crash sites (tony_tpu.chaos): a kill -9 anywhere in the
+        # stage-and-rename must leave the OLD log (before the replace)
+        # or the NEW compacted one (after) — never a torn file. The
+        # fault-injection sweep pins all three boundaries.
+        chaos.crash_point("rotate_before_stage")
         tmp = Path(f"{self.inprogress_path}.tmp.{os.getpid()}")
         with open(tmp, "w", encoding="utf-8") as fh:
             for r in keep:
                 fh.write(json.dumps(r, sort_keys=True) + "\n")
             fh.flush()
             os.fsync(fh.fileno())
+        chaos.crash_point("rotate_after_stage")
         os.replace(tmp, self.inprogress_path)
+        chaos.crash_point("rotate_after_replace")
         self._file = open(self.inprogress_path, "a", encoding="utf-8")
         self.rotations += 1
 
@@ -216,6 +225,19 @@ class EventHandler:
                   n_active=int(n_active),
                   samples=[dict(s) for s in samples], now=float(now),
                   last_action=last_action, policy=dict(policy))
+
+    def resize(self, phase: str, trigger: str, job_type: str,
+               old_workers: int, new_workers: int, wall_s: float,
+               ok: bool, detail: str = "") -> None:
+        """One resize-phase record (tony_tpu.am.resize): the phase name
+        (DRAINING / RE-GANG / RESTORING, or DEGRADED when the machine
+        fell back to the full gang restart), what triggered the resize,
+        the old→new topology, and the phase's wall seconds — `tony
+        history` renders these as the recovery timeline."""
+        self.emit(RESIZE, phase=str(phase), trigger=str(trigger),
+                  job_type=job_type, old_workers=int(old_workers),
+                  new_workers=int(new_workers), wall_s=float(wall_s),
+                  ok=bool(ok), detail=detail)
 
     def close(self) -> None:
         """Finalize: move intermediate → finished (the reference's HDFS

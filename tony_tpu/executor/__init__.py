@@ -36,7 +36,7 @@ import time
 from pathlib import Path
 from typing import Dict, List, Optional
 
-from tony_tpu import constants
+from tony_tpu import chaos, constants
 from tony_tpu import conf as conf_mod
 from tony_tpu import util
 from tony_tpu.conf import TonyConfig
@@ -219,6 +219,16 @@ class TaskExecutor:
         loop piggybacks whatever appears there to the AM."""
         return self.log_dir / "serve-stats.json"
 
+    def drain_file_path(self) -> Path:
+        """The per-container drain flag: the executor exports this path
+        (``TONY_DRAIN_FILE``) into the user env and CREATES the file when
+        the AM's heartbeat reply carries the drain directive; train_loop
+        polls for it between steps and exits EXIT_DRAINED after a
+        synchronous commit. A file, not a signal: the user process may be
+        several forks deep, and the drain must reach the training loop —
+        not whatever shell happens to be the direct child."""
+        return self.log_dir / "drain"
+
     def user_command(self) -> str:
         cmd = (self.conf.get(conf_mod.command_key(self.job_type))
                or self.conf.get("tony.application.executes"))
@@ -338,6 +348,7 @@ class TaskExecutor:
                               timeout=max(1.0, interval_s))
         ckpt_dir = self.conf.get(conf_mod.CKPT_DIR) or None
         serve_stats_path = self.serve_stats_path()
+        drain_path = self.drain_file_path()
 
         def ckpt_step() -> Optional[int]:
             if not ckpt_dir:
@@ -353,6 +364,10 @@ class TaskExecutor:
         failures = 0
         try:
             while not self._hb_stop.wait(interval_s):
+                if chaos.drop_heartbeat():
+                    # Injected silence: the AM sees missed heartbeats, the
+                    # executor stays healthy — the lost-task path under test.
+                    continue
                 try:
                     step = ckpt_step()
                     serve = read_serve_stats(serve_stats_path) \
@@ -362,9 +377,14 @@ class TaskExecutor:
                         extras["ckpt_step"] = step
                     if serve is not None:
                         extras["serve"] = serve
-                    hb_client.call("heartbeat", job_type=self.job_type,
-                                   index=self.index, **extras)
+                    resp = hb_client.call("heartbeat", job_type=self.job_type,
+                                          index=self.index, **extras)
                     failures = 0
+                    if isinstance(resp, dict) and resp.get("drain"):
+                        try:
+                            drain_path.touch()
+                        except OSError:
+                            pass  # retried on the next beat; never fatal
                     if self._am_lost and self.user_proc is None:
                         # The AM was only transiently unreachable (e.g. a
                         # relaunch window) and recovered before launch —
@@ -500,6 +520,15 @@ class TaskExecutor:
             env.update(task_env)
             env[constants.ENV_SERVE_STATS] = str(
                 self.serve_stats_path().resolve())
+            drain_path = self.drain_file_path()
+            try:
+                # Incremental-grant reuse relaunches into this same sandbox:
+                # a drain flag left by the PREVIOUS drain must not instantly
+                # drain the fresh worker.
+                drain_path.unlink()
+            except OSError:
+                pass
+            env[constants.ENV_DRAIN_FILE] = str(drain_path.resolve())
             if self.token:
                 env[ENV_JOB_TOKEN] = self.token
             cwd = str(src) if src else os.getcwd()

@@ -106,6 +106,52 @@ def _downsample(samples: List[Dict[str, Any]],
     return picked
 
 
+def billing_rollup(records: List[Dict[str, Any]],
+                   conf_snapshot: Optional[Dict[str, Any]]) -> Dict[
+                       str, Dict[str, float]]:
+    """Per-tenant billed-token rollup, integrated reader-side from the
+    SERVE_WINDOW ledger: each task's per-tenant ``tokens_per_s`` is
+    held constant until its next window (left-Riemann), summed over the
+    job, then multiplied by the tenant's QoS weight from the job's conf
+    snapshot (``tony.serve.qos.tenants``; unlisted tenants bill at 1.0).
+    Integrates over the RAW record stream — the downsampled portal
+    timelines would under-integrate long jobs."""
+    from tony_tpu.conf import SERVE_QOS_TENANTS
+    from tony_tpu.serve.qos import parse_tenants
+
+    weights: Dict[str, float] = {}
+    raw = str((conf_snapshot or {}).get(SERVE_QOS_TENANTS, "") or "")
+    if raw:
+        try:
+            weights = parse_tenants(raw)
+        except ValueError:
+            weights = {}            # malformed snapshot: bill at weight 1
+    # tid -> (timestamp, {tenant: tokens_per_s}) of that task's last window.
+    last: Dict[str, Any] = {}
+    tokens: Dict[str, float] = {}
+    for r in records:
+        if r["type"] != ev.SERVE_WINDOW:
+            continue
+        p = r["payload"]
+        tid = f"{p['job_type']}:{p['index']}"
+        stats = p.get("stats") or {}
+        tenants = stats.get("tenants") or {}
+        rates = {name: float(t.get("tokens_per_s", 0.0))
+                 for name, t in tenants.items() if isinstance(t, dict)}
+        prev = last.get(tid)
+        if prev is not None:
+            dt = max(0.0, float(r["timestamp"]) - prev[0])
+            for name, rate in prev[1].items():
+                tokens[name] = tokens.get(name, 0.0) + rate * dt
+        last[tid] = (float(r["timestamp"]), rates)
+    out: Dict[str, Dict[str, float]] = {}
+    for name in sorted(tokens):
+        w = float(weights.get(name, 1.0))
+        out[name] = {"tokens": tokens[name], "weight": w,
+                     "billed": tokens[name] * w}
+    return out
+
+
 def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
     """Parsed view of one job: metadata, final status, per-task rows, events
     (reference: JobDetailPageController's model assembly)."""
@@ -146,6 +192,12 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
                     if k not in ("job_type", "index")}})
         elif r["type"] == ev.SCALE_DECISION:
             scale_decisions.append(dict(p, timestamp=r["timestamp"]))
+    # Elastic resize timeline (PR 19): one record per lifecycle phase
+    # (DRAINING / RE-GANG / RESTORING, or DEGRADED) — rendered as the
+    # recovery timeline so an operator can see exactly where a
+    # preemption's wall time went.
+    resizes = [dict(r["payload"], timestamp=r["timestamp"])
+               for r in records if r["type"] == ev.RESIZE]
     serve_windows = {tid: _downsample(s) for tid, s in serve_windows.items()}
     train_steps = {tid: _downsample(s) for tid, s in train_steps.items()}
     # Per-tenant SLO rollup from each task's NEWEST window (qps/queued/
@@ -192,6 +244,8 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
         "serve_windows": serve_windows,
         "train_steps": train_steps,
         "tenant_slo": tenant_slo,
+        "billing": billing_rollup(records, meta.get("config")),
+        "resizes": resizes,
         "scale_decisions": scale_decisions,
         "scale_replay": scale_replay,
         "traces": list_traces(history_root, job["app_id"]),
@@ -269,6 +323,21 @@ def render_show(detail: Dict[str, Any]) -> str:
                        f"step={int(last.get('step', 0))} "
                        f"mfu={float(last.get('mfu', 0.0)):.3f} "
                        f"coll={float(last.get('collective_bytes', 0.0)):.0f}B")
+    if detail.get("resizes"):
+        out.append("  resize timeline:")
+        for p in detail["resizes"]:
+            when = time.strftime("%H:%M:%S", time.localtime(p["timestamp"]))
+            mark = "ok" if p.get("ok") else "FAILED"
+            out.append(f"    {when} {p.get('phase')} "
+                       f"[{p.get('trigger')}] {p.get('job_type')} "
+                       f"{p.get('old_workers')}→{p.get('new_workers')} "
+                       f"{float(p.get('wall_s', 0.0)):.2f}s [{mark}]"
+                       + (f" — {p['detail']}" if p.get("detail") else ""))
+    if detail.get("billing"):
+        out.append("  billing (tokens × weight, integrated over windows):")
+        for name, b in sorted(detail["billing"].items()):
+            out.append(f"    {name}: tokens={b['tokens']:.0f} "
+                       f"weight={b['weight']:g} billed={b['billed']:.0f}")
     if detail.get("scale_replay"):
         ok = sum(1 for v in detail["scale_replay"] if v["match"])
         out.append(f"  scale decisions ({ok}/{len(detail['scale_replay'])} "
@@ -288,6 +357,34 @@ def render_show(detail: Dict[str, Any]) -> str:
     for r in detail["events"]:
         when = time.strftime("%H:%M:%S", time.localtime(r["timestamp"]))
         out.append(f"    {when} {r['type']}")
+    return "\n".join(out)
+
+
+def render_bill(jobs: List[Dict[str, Any]],
+                tenant: Optional[str] = None) -> str:
+    """Cross-job billing statement for one tenant (or all tenants when
+    ``tenant`` is None): each job's reader-side rollup, then the grand
+    total. Pure jhist read — no AM involvement, so it works on finished
+    and running jobs alike."""
+    rows: List[tuple] = []          # (app_id, tenant, tokens, weight, billed)
+    for job in jobs:
+        records = ev.read_events(job["path"])
+        meta = job.get("metadata") or {}
+        for name, b in billing_rollup(records, meta.get("config")).items():
+            if tenant is not None and name != tenant:
+                continue
+            rows.append((job["app_id"], name, b["tokens"], b["weight"],
+                         b["billed"]))
+    who = tenant if tenant is not None else "any tenant"
+    if not rows:
+        return f"no serve-window ledgers found for {who}"
+    out = [f"{'APP ID':<28} {'TENANT':<10} {'TOKENS':>12} "
+           f"{'WEIGHT':>7} {'BILLED':>12}"]
+    for app_id, name, tok, w, billed in rows:
+        out.append(f"{app_id:<28} {name:<10} {tok:>12.0f} "
+                   f"{w:>7g} {billed:>12.0f}")
+    total = sum(r[4] for r in rows)
+    out.append(f"{'TOTAL':<28} {'':<10} {'':>12} {'':>7} {total:>12.0f}")
     return "\n".join(out)
 
 
@@ -406,6 +503,34 @@ def _job_page(detail: Dict[str, Any]) -> str:
                     f"<td>{float(s.get('collective_bytes', 0.0)):.0f}</td>"
                     f"<td>{float(s.get('mfu', 0.0)):.3f}</td></tr>")
             parts.append("</table>")
+    if detail.get("resizes"):
+        parts.append("<h3>Resize timeline</h3><table><tr><th>time</th>"
+                     "<th>phase</th><th>trigger</th><th>gang</th>"
+                     "<th>workers</th><th>wall s</th><th>ok</th>"
+                     "<th>detail</th></tr>")
+        for p in detail["resizes"]:
+            when = time.strftime("%H:%M:%S", time.localtime(p["timestamp"]))
+            mark = ("<b class='ok'>ok</b>" if p.get("ok")
+                    else "<b class='bad'>failed</b>")
+            parts.append(
+                f"<tr><td>{when}</td>"
+                f"<td>{html.escape(str(p.get('phase')))}</td>"
+                f"<td>{html.escape(str(p.get('trigger')))}</td>"
+                f"<td>{html.escape(str(p.get('job_type')))}</td>"
+                f"<td>{p.get('old_workers')}&rarr;{p.get('new_workers')}"
+                f"</td><td>{float(p.get('wall_s', 0.0)):.2f}</td>"
+                f"<td>{mark}</td>"
+                f"<td>{html.escape(str(p.get('detail') or ''))}</td></tr>")
+        parts.append("</table>")
+    if detail.get("billing"):
+        parts.append("<h3>Billing</h3><table><tr><th>tenant</th>"
+                     "<th>tokens</th><th>weight</th><th>billed</th></tr>")
+        for name, b in sorted(detail["billing"].items()):
+            parts.append(
+                f"<tr><td>{html.escape(name)}</td>"
+                f"<td>{b['tokens']:.0f}</td><td>{b['weight']:g}</td>"
+                f"<td>{b['billed']:.0f}</td></tr>")
+        parts.append("</table>")
     if detail.get("scale_replay"):
         parts.append("<h3>Autoscale decisions (replayed)</h3><table><tr>"
                      "<th>time</th><th>gang</th><th>delta</th>"
@@ -521,6 +646,12 @@ def main(args) -> int:
             print(f"no job {args.app_id} found")
             return 1
         print(render_show(job_detail(job)))
+        return 0
+    if args.action == "bill":
+        # The app_id positional doubles as the tenant name: `tony
+        # history bill gold` rolls up gold's billed tokens across every
+        # job the history scan can see; with no tenant, all tenants.
+        print(render_bill(gather_jobs(history_dir), args.app_id or None))
         return 0
     if args.action == "serve":
         # Loopback by default: jhist pages expose full job configs; binding

@@ -21,11 +21,14 @@ reference's ClientToAMToken); mismatches are rejected before dispatch.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
+
+from tony_tpu import chaos
 
 # Env var carrying the job token to executors (security.enabled only).
 ENV_JOB_TOKEN = "TONY_JOB_TOKEN"
@@ -112,10 +115,14 @@ class RpcServer:
 class RpcClient:
     """Reconnecting JSON-lines RPC client (reference: ``ApplicationRpcClient``).
 
-    One persistent connection, re-dialed on failure; every call retries with
-    backoff up to ``timeout`` seconds — executors come up before the AM
-    socket is reachable in some orderings, and the reference's Hadoop RPC
-    retries the same way.
+    One persistent connection, re-dialed on failure; every call retries
+    transport errors up to ``timeout`` seconds with BOUNDED JITTERED
+    exponential backoff (base ``retry_interval``, doubling to
+    :data:`BACKOFF_CAP_S`, ×[0.5, 1.5) jitter) — executors come up before
+    the AM socket is reachable in some orderings, and the reference's
+    Hadoop RPC retries the same way. The jitter keeps a gang of
+    executors whose AM hiccuped from re-dialing in lockstep; the cap
+    keeps a long-timeout call responsive once the fault clears.
     """
 
     def __init__(self, address: str, token: Optional[str] = None,
@@ -128,6 +135,11 @@ class RpcClient:
         self._sock: Optional[socket.socket] = None
         self._file = None
         self._lock = threading.Lock()
+
+    # Backoff ceiling for the transport-retry loop: delays double from
+    # retry_interval up to this cap, so a transient fault early in a long
+    # window is probed promptly while a dead AM is not hammered.
+    BACKOFF_CAP_S = 2.0
 
     # Per-operation socket timeout cap. Individual connect/recv calls are
     # additionally capped by the client's own retry window so that a
@@ -180,8 +192,10 @@ class RpcClient:
         payload = (json.dumps(req) + "\n").encode()
         effective = self.timeout if _timeout is None else _timeout
         per_op = self._per_op(effective)
+        chaos.rpc_delay()
         deadline = time.monotonic() + effective
         last_err: Optional[Exception] = None
+        attempt = 0
         while time.monotonic() < deadline:
             try:
                 with self._lock:
@@ -207,7 +221,15 @@ class RpcClient:
                 last_err = e
                 with self._lock:
                     self._close_locked()
-                time.sleep(self.retry_interval)
+                delay = min(self.retry_interval * (2.0 ** attempt),
+                            self.BACKOFF_CAP_S)
+                delay *= 0.5 + random.random()  # jitter in [0.5x, 1.5x)
+                # Never sleep past the deadline — the loop guard would
+                # otherwise charge the overshoot to the caller's budget.
+                delay = min(delay, max(0.0, deadline - time.monotonic()))
+                attempt += 1
+                if delay > 0:
+                    time.sleep(delay)
         raise ConnectionError(
             f"RPC {method} to {self._addr} failed after {effective}s: "
             f"{last_err}")
@@ -262,6 +284,9 @@ class ApplicationRpcHandler:
         self.on_metrics: Optional[Callable[[str, int, Dict[str, float]],
                                            None]] = None
         self.on_callback_info: Optional[Callable[[str, str], None]] = None
+        # Armed by the AM only when tony.resize.enabled — an unset slot
+        # makes the ``tony resize`` verb a clean application error.
+        self.on_resize: Optional[Callable[[int], None]] = None
         self._all_registered_fired = False
         self._fire_lock = threading.Lock()
 
@@ -303,14 +328,38 @@ class ApplicationRpcHandler:
 
     def rpc_heartbeat(self, job_type: str, index: int,
                       ckpt_step: Optional[int] = None,
-                      serve: Optional[Dict[str, float]] = None) -> bool:
+                      serve: Optional[Dict[str, float]] = None) -> Any:
         """Liveness + checkpoint progress + serving telemetry: executors
         that see a ``tony.ckpt.dir`` piggyback the last COMMITTED step;
         serve-replica executors piggyback the engine's published
         qps/p99_ms/queue_depth (the autoscaler's signal). Both params
-        optional — seed-era executors send neither."""
+        optional — seed-era executors send neither.
+
+        Returns bare ``True`` normally; when an elastic resize has the
+        gang draining, returns ``{"ok": True, "drain": True}`` so the
+        executor can relay the drain directive to its user process (the
+        asymmetry keeps seed-era executors, which only truth-test the
+        reply, working unchanged)."""
         self.session.on_heartbeat(job_type, index, ckpt_step=ckpt_step,
                                   serve=serve)
+        if self.session.drain_pending(job_type, index):
+            return {"ok": True, "drain": True}
+        return True
+
+    def rpc_resize(self, num_workers: int) -> bool:
+        """Operator-triggered elastic resize (``tony resize N``): ask the
+        AM to drain, commit, and re-gang at ``num_workers``. Validation of
+        the target count is the AM's job (it knows min-workers and whether
+        a resize is already in flight); here we only reject garbage and
+        require the AM to have opted in via the callback slot."""
+        n = int(num_workers)
+        if n < 1:
+            raise ValueError(f"resize target must be >= 1, got {n}")
+        if self.on_resize is None:
+            raise RuntimeError(
+                "resize is not enabled for this application "
+                "(tony.resize.enabled=false)")
+        self.on_resize(n)
         return True
 
     def rpc_register_execution_result(self, job_type: str, index: int,

@@ -28,7 +28,9 @@ from typing import Any, Dict, List, Optional, Sequence
 from tony_tpu.conf import (SERVE_COOLDOWN_S, SERVE_P99_HIGH_MS,
                            SERVE_QUEUE_HIGH, SERVE_QUEUE_LOW,
                            SERVE_REPLICAS_MAX, SERVE_REPLICAS_MIN,
-                           SERVE_SLO_TARGET_MS, serve_replicas_max_key)
+                           SERVE_SLO_TARGET_MS, SERVE_SLO_TARGETS,
+                           serve_replicas_max_key)
+from tony_tpu.serve.qos import parse_tenants
 
 
 def apportion_fleet_max(floors: Dict[str, int],
@@ -71,11 +73,25 @@ class ScalingPolicy:
     # the same latency windows the history plane logs, so a replayed
     # event log reproduces the live decisions exactly.
     slo_target_ms: float = 0.0
+    # Per-tenant SLO targets (PR 19; ``--slo_target_ms gold:200,
+    # silver:800``): each named tenant's fleet-worst p99 is measured
+    # against its OWN target and the gang scales on the worst
+    # p99/target ratio — one tenant blowing its promise is a scale-up
+    # even when the fleet aggregate looks healthy. Composes with the
+    # fleet-wide ``slo_target_ms`` (both ratios compete); a dict field
+    # JSON-round-trips through SCALE_DECISION records so replay stays
+    # exact, and old records without the key get the empty default.
+    slo_targets: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         if self.slo_target_ms < 0:
             raise ValueError(f"slo_target_ms must be >= 0, got "
                              f"{self.slo_target_ms}")
+        for name, target in self.slo_targets.items():
+            if not name or not target > 0:
+                raise ValueError(
+                    f"slo target for tenant {name!r} must be > 0, "
+                    f"got {target!r}")
         if self.min_replicas < 1:
             raise ValueError(f"min_replicas must be >= 1, got "
                              f"{self.min_replicas}")
@@ -119,6 +135,8 @@ class ScalingPolicy:
             p99_high_ms=conf.get_float(SERVE_P99_HIGH_MS, 0.0),
             cooldown_s=conf.get_float(SERVE_COOLDOWN_S, 30.0),
             slo_target_ms=conf.get_float(SERVE_SLO_TARGET_MS, 0.0),
+            slo_targets=(parse_tenants(conf.get(SERVE_SLO_TARGETS))
+                         if conf.get(SERVE_SLO_TARGETS) else {}),
         )
 
     @property
@@ -143,11 +161,15 @@ def decide(policy: ScalingPolicy, n_running: int,
       above ``p99_high_ms`` when enabled — and below the ceiling: +1;
       mean queue depth below ``queue_low``, p99 comfortably under the
       high-water, and above the floor: −1;
-    * **SLO mode** (``slo_target_ms > 0``): the gang's worst p99 above
-      the target and below the ceiling: +1; p99 under HALF the target
-      AND mean queue depth under ``queue_low`` (latency headroom alone
-      is not idleness — an empty window also reads p99=0) and above the
-      floor: −1.
+    * **SLO mode** (``slo_target_ms > 0`` or per-tenant
+      ``slo_targets``): every armed promise becomes a p99/target ratio
+      — the gang's worst p99 against the fleet target, plus each named
+      tenant's fleet-worst p99 against its own target — and the WORST
+      ratio rules: above 1.0 and below the ceiling: +1; under 0.5 AND
+      mean queue depth under ``queue_low`` (latency headroom alone is
+      not idleness — an empty window also reads p99=0) and above the
+      floor: −1. With only the fleet target armed this is the PR 18
+      single-target behavior verbatim.
     """
     if n_running < policy.min_replicas:
         return policy.min_replicas - n_running
@@ -158,9 +180,20 @@ def decide(policy: ScalingPolicy, n_running: int,
     qd = sum(float(s.get("queue_depth", 0.0)) for s in samples) \
         / len(samples)
     p99 = max(float(s.get("p99_ms", 0.0)) for s in samples)
-    if policy.slo_target_ms > 0:
-        hot = p99 > policy.slo_target_ms
-        cold = p99 < 0.5 * policy.slo_target_ms and qd < policy.queue_low
+    if policy.slo_target_ms > 0 or policy.slo_targets:
+        ratios = []
+        if policy.slo_target_ms > 0:
+            ratios.append(p99 / policy.slo_target_ms)
+        for name, target in policy.slo_targets.items():
+            tenant_p99 = max(
+                (float(t.get("p99_ms", 0.0))
+                 for s in samples
+                 for t in [(s.get("tenants") or {}).get(name)]
+                 if isinstance(t, dict)), default=0.0)
+            ratios.append(tenant_p99 / float(target))
+        worst = max(ratios)
+        hot = worst > 1.0
+        cold = worst < 0.5 and qd < policy.queue_low
     else:
         hot = qd > policy.queue_high or (
             policy.p99_high_ms > 0 and p99 > policy.p99_high_ms)

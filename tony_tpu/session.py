@@ -37,11 +37,13 @@ class TaskStatus(enum.Enum):
     FAILED = "FAILED"
     LOST = "LOST"                # missed-heartbeat expiry
     KILLED = "KILLED"            # torn down (untracked at job end, or preempted)
+    DRAINED = "DRAINED"          # clean elastic-resize exit (committed + left)
 
     @property
     def is_terminal(self) -> bool:
         return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED,
-                        TaskStatus.LOST, TaskStatus.KILLED)
+                        TaskStatus.LOST, TaskStatus.KILLED,
+                        TaskStatus.DRAINED)
 
 
 class JobStatus(enum.Enum):
@@ -177,6 +179,11 @@ class TonySession:
         # submit → all-RUNNING latency, set by the AM when the gang barrier
         # passes (BASELINE.md secondary metric).
         self.all_running_latency_s: Optional[float] = None
+        # Elastic-resize drain (tony_tpu.am.resize): while True, the
+        # heartbeat response tells every live task to commit-and-exit,
+        # and the success policy holds its verdict — the resize
+        # controller, not task completion, decides what happens next.
+        self._draining = False
         self._tasks: Dict[Tuple[str, int], TonyTask] = {}
         untracked = set(conf.untracked_job_types())
         for jt in conf.job_types():
@@ -362,6 +369,43 @@ class TonySession:
                     and not (t.serve_metrics.get("warm_standby")
                              and not t.status.is_terminal)]
 
+    # -- elastic-resize drain (tony_tpu.am.resize) -------------------------
+    def request_drain(self) -> None:
+        """Arm the drain directive: every subsequent heartbeat response
+        carries it, and the success policy freezes until the resize
+        controller rules (clean drains must not read as job success)."""
+        with self.lock:
+            self._draining = True
+
+    def clear_drain(self) -> None:
+        with self.lock:
+            self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        with self.lock:
+            return self._draining
+
+    def drain_pending(self, job_type: str, index: int) -> bool:
+        """Should this task's heartbeat response carry the drain
+        directive? True for any live task while a drain is armed."""
+        with self.lock:
+            if not self._draining:
+                return False
+            try:
+                t = self.task(job_type, index)
+            except KeyError:
+                return False
+            return not t.status.is_terminal
+
+    def drain_complete(self, job_type: str) -> bool:
+        """True once every tracked task of ``job_type`` is terminal —
+        the DRAINING phase's completion predicate."""
+        with self.lock:
+            gang = [t for t in self._tasks.values()
+                    if t.job_type == job_type and t.tracked]
+            return bool(gang) and all(t.status.is_terminal for t in gang)
+
     def last_committed_step(self) -> Optional[int]:
         """Newest checkpoint step any executor has reported committed —
         what the next attempt will resume from (commit is global: process
@@ -381,7 +425,15 @@ class TonySession:
             t.exit_code = int(exit_code)
             t.diagnostics = diagnostics
             t.end_time = time.monotonic()
-            t.status = TaskStatus.SUCCEEDED if exit_code == 0 else TaskStatus.FAILED
+            if exit_code == 0:
+                t.status = TaskStatus.SUCCEEDED
+            elif exit_code == constants.EXIT_DRAINED:
+                # Clean elastic-resize exit: the task committed its
+                # model+cursor and left on request — terminal, but
+                # neither a success nor a failure of the job.
+                t.status = TaskStatus.DRAINED
+            else:
+                t.status = TaskStatus.FAILED
             self._update_job_status()
             return t
 
@@ -429,6 +481,12 @@ class TonySession:
         that forgets the lock instead of trusting the docstring."""
         with self.lock:
             if self.job_status != JobStatus.RUNNING:
+                return
+            if self._draining:
+                # Mid-resize: tasks are SUPPOSED to go terminal (drained
+                # survivors, the preempted victim). The resize controller
+                # owns the verdict; a frozen success policy can never
+                # misread a drained gang as a finished job.
                 return
             fail_fast = self.conf.get_bool(
                 "tony.application.fail-fast", True)

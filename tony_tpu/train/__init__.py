@@ -30,7 +30,7 @@ import optax
 from flax.training.train_state import TrainState
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tony_tpu import constants
+from tony_tpu import chaos, constants
 from tony_tpu import parallel as par
 from tony_tpu.compat import mesh_context
 from tony_tpu.parallel import overlap
@@ -508,7 +508,8 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                mesh: Optional[Mesh] = None,
                save_final: bool = True,
                on_step: Optional[Callable[[int, Dict[str, Any]],
-                                          None]] = None):
+                                          None]] = None,
+               drain_file: Optional[str] = None):
     """Drive ``step_fn`` over ``batches`` with integrated elastic
     checkpointing — the control-plane hook the gang-restart contract needs
     (``tony.am.retry-count``): attempt N+1 calls this exactly like attempt
@@ -542,6 +543,14 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
     restores the model alone and the stream starts from the iterator's
     current position.
 
+    ``drain_file`` (default: the ``TONY_DRAIN_FILE`` env the executor
+    injects) is the elastic-resize drain flag: the loop polls for it
+    between steps, and when it appears commits model + data cursor
+    SYNCHRONOUSLY (the resize controller may only re-gang against a
+    durable manifest) and exits with ``SystemExit(EXIT_DRAINED)`` — the
+    executor reports that code and the AM records the worker DRAINED,
+    not failed.
+
     Returns ``(state, last_metrics)``.
     """
     from tony_tpu import ckpt as ckpt_mod
@@ -560,6 +569,8 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                          or 0)
     if keep is None:
         keep = int(os.environ.get(constants.ENV_CKPT_KEEP, "3") or 3)
+    if drain_file is None:
+        drain_file = os.environ.get(constants.ENV_DRAIN_FILE) or None
     mgr = None
     if ckpt_dir:
         from tony_tpu.data import ckptio
@@ -614,12 +625,25 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
         for batch in batches:
             state, metrics = step_fn(state, batch)
             done += 1
+            chaos.kill_point(done)
             if on_step is not None:
                 on_step(done, metrics)
             if mgr is not None and save_every and done % save_every == 0:
                 saved_at = int(jax.device_get(state.step)) \
                     if hasattr(state, "step") else done
                 mgr.save(payload(), step=saved_at)
+            if drain_file is not None and os.path.exists(drain_file):
+                # Drain directive (elastic resize): commit model + cursor
+                # SYNCHRONOUSLY — wait() both drains the async queue and
+                # re-raises any pending writer failure, so EXIT_DRAINED
+                # is only ever reported over a durable manifest.
+                if mgr is not None:
+                    here = int(jax.device_get(state.step)) \
+                        if hasattr(state, "step") else done
+                    if here != saved_at:
+                        mgr.save(payload(), step=here)
+                    mgr.wait()
+                raise SystemExit(constants.EXIT_DRAINED)
         if mgr is not None and save_final and done:
             final = int(jax.device_get(state.step)) \
                 if hasattr(state, "step") else done
