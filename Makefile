@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-elastic tier1-slow quick test lint
+.PHONY: tier1 tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-elastic tier1-publish tier1-slow quick test lint
 
 # THE gate: the verbatim ROADMAP command, then the explicit multislice leg
 # (hierarchical ICI/DCN + ZeRO-3 paths on the simulated 2-slice mesh), the
@@ -15,7 +15,7 @@ SHELL := /bin/bash
 # regression there fails the make target by name, not just as one more
 # dot. Legs run SEQUENTIALLY (the no-concurrent-pytest rule: e2e timing
 # tests flake under CPU contention).
-tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-elastic
+tier1: tier1-verify tier1-multislice tier1-ckpt tier1-data tier1-sched tier1-optim tier1-quant tier1-analysis tier1-serve tier1-spec tier1-route tier1-conc tier1-disagg tier1-kvtier tier1-aot tier1-qos tier1-elastic tier1-publish
 
 # Exact ROADMAP.md "Tier-1 verify" command, verbatim.
 tier1-verify:
@@ -167,6 +167,21 @@ tier1-qos:
 # named leg is the lane's full gate (slow included).
 tier1-elastic:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Continuous-publication marker leg (tony_tpu.publish + tony_tpu.serve.
+# swap PR 20) — the published.json pointer's stage-and-rename crash
+# sweep (old pointer or new, never torn), resolve_target's pointer/pin/
+# race rules, the FleetSwapController rolling-swap policy, the in-place
+# hot weight swap pinned BITWISE vs a fresh replica restored from the
+# same manifest with ZERO dropped requests under concurrent traffic,
+# the four-site swap chaos sweep (exactly one weight version per
+# replica), the routed 2-replica rolling-fleet headline, history
+# billing windows, and tony aot gc. The replica hot-swap and
+# rolling-fleet legs are slow-marked to keep tier1-verify inside its
+# (tight — ROADMAP) 870 s budget, but this named leg is the lane's
+# full gate (slow included).
+tier1-publish:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m publish -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Source lints, machine-checked: (1) the jnp.concatenate/stack pack-site
 # lint (the jax-0.4 GSPMD concat-reshard footgun) — every call site

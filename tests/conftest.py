@@ -56,6 +56,7 @@ _TIER_BY_MODULE = {
     "test_aot": "jit",
     "test_qos": "jit",
     "test_elastic": "jit",
+    "test_publish": "jit",
     "test_e2e": "e2e", "test_client_cli": "e2e",
 }
 

@@ -986,15 +986,16 @@ class TestDisaggOverRpc:
                 self._decode_front = DecodeFront(self._front)
 
             def generate(self, tokens, max_new_tokens, rid=None,
-                         conv=None):
+                         conv=None, tenant=None):
                 return self._front.generate(tokens, max_new_tokens,
-                                            rid=rid, conv=conv)
+                                            rid=rid, conv=conv,
+                                            tenant=tenant)
 
             def prefill_handoff(self, tokens, max_new_tokens, rid=None,
-                                decode=None, conv=None):
+                                decode=None, conv=None, tenant=None):
                 return self._prefill_front.prefill_handoff(
                     tokens, max_new_tokens, rid=rid, decode=decode,
-                    conv=conv)
+                    conv=conv, tenant=tenant)
 
             def kv_offer(self, keys):
                 return self._decode_front.kv_offer(keys)

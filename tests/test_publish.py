@@ -1,0 +1,898 @@
+"""Continuous-publication legs (tony_tpu.publish + tony_tpu.serve.swap
+PR 20): the versioned pointer file's stage-and-rename crash sweep (old
+pointer or new, never torn), resolve_target's pointer/pin/race rules,
+the FleetSwapController rolling-swap policy on a fake clock, warm()'s
+pad self-tuner, the prefix/host-tier flush on swap, the hot in-place
+weight swap pinned BITWISE vs a fresh replica restored from the same
+manifest with zero dropped requests under concurrent traffic, the
+chaos sweep at every swap boundary (exactly one weight version per
+replica — rolled back whole or committed whole), the router's
+swap-window down-mark, `tony history bill --json/--csv --since/--until`,
+`tony aot gc`, and the PUBLISH→SWAP jhist timeline."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu import chaos
+from tony_tpu import events as ev
+from tony_tpu import history, publish
+from tony_tpu.ckpt.format import MANIFEST_NAME, committed_steps, step_dir
+from tony_tpu.serve.swap import (FleetSwapController, SwapError,
+                                 derive_prefill_pads, resolve_target)
+
+pytestmark = pytest.mark.publish
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean(monkeypatch):
+    """No chaos schedule or hook leaks between tests."""
+    for name in (chaos.ENV_KILL_STEP, chaos.ENV_HB_DROP,
+                 chaos.ENV_RPC_DELAY_S, chaos.ENV_RPC_DELAY_CALLS,
+                 chaos.ENV_CRASH):
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.setattr(chaos, "KILL_HOOK", None)
+    monkeypatch.setattr(chaos, "CRASH_HOOK", None)
+    monkeypatch.setattr(chaos, "SLEEP_HOOK", None)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def commit_fake_steps(root: Path, *steps: int) -> None:
+    """Committed-looking step dirs: the pointer plane only reads the
+    manifest's EXISTENCE (committed_steps), never its contents."""
+    root.mkdir(parents=True, exist_ok=True)
+    for s in steps:
+        d = step_dir(root, s)
+        d.mkdir(exist_ok=True)
+        (d / MANIFEST_NAME).write_text("{}")
+
+
+class _Crashed(RuntimeError):
+    """CRASH_HOOK's in-process stand-in for SIGKILL."""
+
+
+# ---------------------------------------------------------------------------
+# The pointer file: publish_step / latest_publication
+# ---------------------------------------------------------------------------
+
+class TestPublishPointer:
+    def test_roundtrip_versions_and_rollback(self, tmp_path):
+        commit_fake_steps(tmp_path, 3, 7)
+        rec = publish.publish_step(tmp_path)            # default: newest
+        assert (rec["version"], rec["step"]) == (1, 7)
+        assert rec["manifest"] == f"step_{7:08d}/{MANIFEST_NAME}"
+        # Rollback: an OLDER step under a NEWER version — the fleet
+        # compares versions, so the roll-back still propagates.
+        rec = publish.publish_step(tmp_path, 3, note="bad eval")
+        assert (rec["version"], rec["step"]) == (2, 3)
+        assert rec["note"] == "bad eval"
+        # Re-publishing the same step is a "converge again" push, not a
+        # no-op: it mints version 3.
+        rec = publish.publish_step(tmp_path, 3)
+        assert (rec["version"], rec["step"]) == (3, 3)
+        back = publish.latest_publication(tmp_path)
+        assert (back["version"], back["step"]) == (3, 3)
+
+    def test_uncommitted_or_empty_raises(self, tmp_path):
+        with pytest.raises(publish.PublishError):
+            publish.publish_step(tmp_path)              # nothing committed
+        commit_fake_steps(tmp_path, 2)
+        with pytest.raises(publish.PublishError):
+            publish.publish_step(tmp_path, 5)           # never committed
+        # A .tmp staging dir is NOT committed — publishing it must fail.
+        (tmp_path / f"step_{9:08d}.tmp").mkdir()
+        with pytest.raises(publish.PublishError):
+            publish.publish_step(tmp_path, 9)
+
+    def test_latest_publication_failure_silent(self, tmp_path):
+        assert publish.latest_publication(tmp_path) is None
+        (tmp_path).mkdir(exist_ok=True)
+        (tmp_path / publish.PUBLISH_FILE).write_text("{ torn half-writ")
+        assert publish.latest_publication(tmp_path) is None
+        (tmp_path / publish.PUBLISH_FILE).write_text('{"version": "x"}')
+        assert publish.latest_publication(tmp_path) is None
+
+    @pytest.mark.parametrize("site", ["publish_before_stage",
+                                      "publish_after_stage",
+                                      "publish_after_replace"])
+    def test_crash_sweep_old_or_new_never_torn(self, site, tmp_path,
+                                               monkeypatch):
+        commit_fake_steps(tmp_path, 3, 7)
+        old = publish.publish_step(tmp_path, 3)         # v1 -> step 3
+
+        def hook(where):
+            raise _Crashed(where)
+
+        monkeypatch.setattr(chaos, "CRASH_HOOK", hook)
+        monkeypatch.setenv(chaos.ENV_CRASH, site)
+        with pytest.raises(_Crashed):
+            publish.publish_step(tmp_path, 7)
+        rec = publish.latest_publication(tmp_path)
+        assert rec is not None, f"crash at {site} left a torn pointer"
+        if site == "publish_after_replace":
+            assert (rec["version"], rec["step"]) == (2, 7)
+        else:
+            assert (rec["version"], rec["step"]) == \
+                (old["version"], old["step"])
+        # The crash's staging leftovers never poison the NEXT publish.
+        monkeypatch.delenv(chaos.ENV_CRASH)
+        nxt = publish.publish_step(tmp_path, 7)
+        assert nxt["version"] == rec["version"] + 1 and nxt["step"] == 7
+
+    def test_train_loop_publishes_on_save_cadence(self, tmp_path,
+                                                  monkeypatch):
+        from tony_tpu import constants
+        from tony_tpu import train as tr
+
+        monkeypatch.delenv(constants.ENV_PUBLISH_EVERY, raising=False)
+        root = tmp_path / "ckpt"
+        tr.train_loop({"w": np.zeros(2, np.float32)},
+                      lambda state, batch: (state, {}), [{}] * 6,
+                      ckpt_dir=str(root), save_every=2, publish_every=2)
+        rec = publish.latest_publication(root)
+        # Saves land at 2/4/6; every 2nd save publishes (step 4), and
+        # the final save always publishes (step 6) — pointer at 6, v2.
+        assert rec is not None
+        assert (rec["version"], rec["step"]) == (2, 6)
+        assert rec["step"] in committed_steps(root)
+
+
+# ---------------------------------------------------------------------------
+# resolve_target
+# ---------------------------------------------------------------------------
+
+class TestResolveTarget:
+    def test_pointer_pin_and_race_rules(self, tmp_path):
+        commit_fake_steps(tmp_path, 3, 7)
+        with pytest.raises(SwapError):
+            resolve_target(tmp_path)                    # no publication
+        publish.publish_step(tmp_path, 7)               # v1 -> 7
+        assert resolve_target(tmp_path) == (1, 7)
+        assert resolve_target(tmp_path, version=1) == (1, 7)
+        # Pointer raced past the version the caller saw: typed failure,
+        # never a silent swap onto other weights.
+        with pytest.raises(SwapError):
+            resolve_target(tmp_path, version=99)
+        # Explicit step pin: the pointer's version when it names that
+        # step, the unpublished version 0 otherwise.
+        assert resolve_target(tmp_path, step=7) == (1, 7)
+        assert resolve_target(tmp_path, step=3) == (0, 3)
+        with pytest.raises(SwapError):
+            resolve_target(tmp_path, step=5)            # uncommitted
+
+
+# ---------------------------------------------------------------------------
+# FleetSwapController (fake clock: pure policy, no threads, no jax)
+# ---------------------------------------------------------------------------
+
+def _fleet(*rows):
+    return [{"id": rid, "version": v, "standby": sb, "index": i}
+            for rid, v, sb, i in rows]
+
+
+class TestFleetSwapController:
+    def _ctl(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("timeout_s", 10.0)
+        kw.setdefault("cooldown_s", 5.0)
+        return FleetSwapController(clock=lambda: self.now[0], **kw)
+
+    def test_standby_first_one_in_flight_version_skip(self):
+        ctl = self._ctl()
+        fleet = _fleet(("a", 1, False, 0), ("b", 1, True, 2),
+                       ("c", 1, False, 1))
+        assert ctl.next_replica(fleet) is None          # no target yet
+        assert ctl.set_target(2, 10) is True
+        assert ctl.set_target(2, 10) is False           # same version: no edge
+        assert ctl.set_target(1, 5) is False            # older: never adopted
+        # Warm standby first — the free dry run — then actives by index.
+        assert ctl.next_replica(fleet) == "b"
+        ctl.begin("b")
+        assert ctl.next_replica(fleet) is None          # one in flight
+        ctl.finish("b", True)
+        fleet = _fleet(("a", 1, False, 0), ("b", 2, True, 2),
+                       ("c", 1, False, 1))
+        assert ctl.next_replica(fleet) == "a"
+        ctl.begin("a"); ctl.finish("a", True)
+        fleet = _fleet(("a", 2, False, 0), ("b", 2, True, 2),
+                       ("c", 1, False, 1))
+        assert ctl.next_replica(fleet) == "c"
+        ctl.begin("c"); ctl.finish("c", True)
+        # Everyone at target: converged, nothing to do.
+        assert ctl.next_replica(_fleet(("a", 2, False, 0),
+                                       ("b", 2, True, 2),
+                                       ("c", 2, False, 1))) is None
+        assert ctl.swapped == 3 and ctl.failed == 0
+
+    def test_failure_cooldown_and_new_target_clears_it(self):
+        ctl = self._ctl()
+        ctl.set_target(2, 10)
+        fleet = _fleet(("a", 1, False, 0))
+        ctl.begin("a"); ctl.finish("a", False)
+        assert ctl.failed == 1
+        assert ctl.next_replica(fleet) is None          # cooling down
+        self.now[0] = 4.9
+        assert ctl.next_replica(fleet) is None
+        self.now[0] = 5.1
+        assert ctl.next_replica(fleet) == "a"           # cooldown over
+        ctl.begin("a"); ctl.finish("a", False)
+        # A NEWER publication may be the fix — it clears the cooldown.
+        assert ctl.set_target(3, 11) is True
+        assert ctl.next_replica(fleet) == "a"
+
+    def test_timeout_reap_and_idempotent_late_finish(self):
+        ctl = self._ctl()
+        ctl.set_target(2, 10)
+        ctl.begin("a")
+        assert ctl.check_timeout() is None
+        self.now[0] = 10.5
+        assert ctl.check_timeout() == "a"               # wedged: reaped
+        assert ctl.in_flight is None and ctl.failed == 1
+        ctl.finish("a", True)                           # thread's late finish
+        assert ctl.swapped == 0 and ctl.failed == 1     # no double count
+        # The reap opened a cooldown window too.
+        assert ctl.next_replica(_fleet(("a", 1, False, 0))) is None
+        self.now[0] = 16.0
+        assert ctl.next_replica(_fleet(("a", 1, False, 0))) == "a"
+
+    def test_run_records_outcome(self):
+        calls = []
+
+        def swap_fn(rid):
+            calls.append(rid)
+            if rid == "bad":
+                raise RuntimeError("poisoned manifest")
+
+        ctl = FleetSwapController(swap_fn, clock=time.monotonic)
+        ok, detail, wall = ctl.run("good")
+        assert ok and detail == "" and wall >= 0.0
+        ok, detail, _ = ctl.run("bad")
+        assert not ok and "poisoned manifest" in detail
+        assert calls == ["good", "bad"]
+        assert ctl.swapped == 1 and ctl.failed == 1
+        with pytest.raises(ValueError):
+            FleetSwapController().run("x")              # policy-only mode
+
+
+# ---------------------------------------------------------------------------
+# warm() pad self-tuning
+# ---------------------------------------------------------------------------
+
+class TestDerivePrefillPads:
+    def test_filters_ranks_and_sorts(self):
+        records = [
+            # jhist SERVE_WINDOW shape...
+            {"type": ev.SERVE_WINDOW, "payload": {"stats": {"prompt_hist": {
+                "16": 5.0, "48": 2.0, "33": 9.0}}}},
+            # ...and a raw stats dict both parse.
+            {"prompt_hist": {"16": 1.0, "32": 4.0, "128": 9.0,
+                             "-16": 3.0, "x": 1.0}},
+        ]
+        # 33 not a q_block multiple, 128 > ctx_max, -16/x garbage.
+        assert derive_prefill_pads(records, q_block=16, ctx_max=64) == \
+            [16, 32, 48]
+        # limit keeps the most-frequent pads, returned ascending.
+        assert derive_prefill_pads(records, q_block=16, ctx_max=64,
+                                   limit=2) == [16, 32]
+        assert derive_prefill_pads([], q_block=16) == []
+        assert derive_prefill_pads([{"payload": {}}], q_block=16) == []
+
+
+# ---------------------------------------------------------------------------
+# Swap hygiene: the prefix/host tiers flush, parked conversations stay
+# ---------------------------------------------------------------------------
+
+class TestFlushPrefix:
+    def test_flush_unindexes_device_and_host_tiers(self):
+        from tony_tpu.serve import PagedKVCache
+
+        c = PagedKVCache(2, 8, n_blocks=8, block_size=4, host_blocks=4)
+        t_a = c.reserve("a", 8)
+        assert c.publish_block("a", 0, "k0")
+        assert c.publish_block("a", 1, "k1")
+        t_b = c.reserve("b", 4)
+        assert c.publish_block("b", 0, "k2")
+        c.free_seq("a")                 # k0/k1 -> refcount-0 cached tier
+        assert c.demote(1) == 1         # coldest stem -> host tier
+        assert len(c.host_keys()) == 1
+        free_before = c.free_blocks
+        # Three entries invalidated: one host stem + two indexed blocks
+        # (k2's block is still OWNED by "b" — unindexed but not freed).
+        assert c.flush_prefix() == 3
+        assert c.host_keys() == [] and c.match_prefix(["k0", "k1"]) == []
+        assert c.match_prefix(["k2"]) == []
+        # The refcount-0 resident moved from the (already reclaimable)
+        # LRU tier to the LIFO free list — the free_blocks total is
+        # unchanged, the pool just lost its adoptable index entries.
+        assert c.free_blocks == free_before == c.n_blocks - len(t_b)
+        # ...and b's still-referenced block frees normally afterwards.
+        owned = c.free_seq("b")
+        assert owned == len(t_b) and c.free_blocks == c.n_blocks
+
+
+# ---------------------------------------------------------------------------
+# Shared tiny model + engine-level swap unit legs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    import flax.linen as nn
+
+    from tony_tpu.models import get_model
+
+    model = get_model("llama-tiny", n_layers=2)
+    sample = jnp.zeros((1, 16), jnp.int32)
+
+    def init(seed):
+        p = nn.unbox(model.init(jax.random.PRNGKey(seed),
+                                sample))["params"]
+        return jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, p)
+
+    return model, init(0), init(7)
+
+
+def make_engine(tiny, **kw):
+    from tony_tpu.serve import ServeEngine
+
+    model, params, _ = tiny
+    kw.setdefault("ctx_max", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("q_block", 16)
+    kw.setdefault("decode_buckets", (2, 4))
+    kw.setdefault("max_running", 4)
+    kw.setdefault("keep_logits", True)
+    return ServeEngine(model, params, **kw)
+
+
+class TestEngineSwap:
+    def test_stats_schema_and_prompt_hist(self, tiny):
+        from tony_tpu.serve import Request
+
+        eng = make_engine(tiny)
+        eng.submit(Request(rid="a", tokens=list(range(6)),
+                           max_new_tokens=2))
+        eng.submit(Request(rid="b", tokens=list(range(20)),
+                           max_new_tokens=2))
+        eng.run()
+        s = eng.stats()
+        assert s["weight_version"] == 0.0 and s["weight_step"] == 0.0
+        assert s["weight_swaps"] == 0.0 and s["swapping"] == 0.0
+        # Histogram keys are the PADDED prompt lengths (q_block=16).
+        assert s["prompt_hist"] == {"16": 1.0, "32": 1.0}
+        # The heartbeat normalizer passes the new keys through whole.
+        from tony_tpu.util import normalize_serve_telemetry
+
+        wire = normalize_serve_telemetry(json.loads(json.dumps(s)))
+        assert wire["prompt_hist"] == {"16": 1.0, "32": 1.0}
+        assert wire["weight_version"] == 0.0
+
+    def test_swap_params_bitwise_and_zero_recompile(self, tiny):
+        from tony_tpu.serve import Request
+
+        model, params1, params2 = tiny
+        eng = make_engine(tiny)
+        prompt = list(range(5))
+        eng.submit(Request(rid="pre", tokens=prompt, max_new_tokens=4))
+        pre = eng.run()[0]
+        fns = dict(eng._fns)
+        eng.swap_params(params2, version=3, step=20)
+        assert eng.weight_version == 3 and eng.weight_step == 20
+        assert eng.weight_swaps == 1
+        eng.submit(Request(rid="post", tokens=prompt, max_new_tokens=4))
+        post = eng.run()[0]
+        # Same geometry, same step programs: the swap compiled NOTHING.
+        assert dict(eng._fns) == fns
+        # Post-swap output is bitwise the params2 engine's, not params1's.
+        ref = make_engine((model, params2, None))
+        ref.submit(Request(rid="r", tokens=prompt, max_new_tokens=4))
+        ref_c = ref.run()[0]
+        assert post.tokens == ref_c.tokens
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(post.logits, ref_c.logits))
+        assert pre.tokens != post.tokens or not all(
+            np.array_equal(a, b) for a, b in zip(pre.logits, post.logits))
+
+    def test_swap_geometry_mismatch_rolls_back(self, tiny):
+        model, params1, _ = tiny
+        eng = make_engine(tiny)
+        # A one-leaf tree never matches the model's treedef.
+        with pytest.raises(SwapError):
+            eng.swap_params({"w": jnp.zeros((2,), jnp.bfloat16)},
+                            version=9, step=9)
+        assert eng.weight_version == 0 and eng.weight_swaps == 0
+        assert eng.params is params1    # old reference, untouched
+
+
+# ---------------------------------------------------------------------------
+# The replica hot swap: pointer-seeded startup, bitwise pin, zero drops,
+# chaos at every boundary
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_step_ckpt(tmp_path_factory):
+    """Two committed REAL checkpoints (different param values) the
+    elastic restore can land: step 1 and step 2."""
+    import optax
+
+    from tony_tpu import ckpt, train
+    from tony_tpu.models import get_model
+
+    root = tmp_path_factory.mktemp("pub") / "ckpt"
+    model = get_model("llama-tiny", n_layers=2)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 256, (4, 16)),
+                         jnp.int32)
+    mgr = ckpt.AsyncCheckpointer(root)
+    for step, seed in ((1, 0), (2, 7)):
+        state = train.create_train_state(
+            model, optax.adamw(1e-3), tokens, jax.random.PRNGKey(seed))
+        mgr.save(state, step=step, block=True)
+    mgr.close()
+    return str(root)
+
+
+def _make_replica(root, **kw):
+    from tony_tpu.serve.replica import Replica
+
+    kw.setdefault("ctx_max", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("q_block", 16)
+    kw.setdefault("max_running", 4)
+    kw.setdefault("keep_logits", True)
+    return Replica(model_name="llama-tiny", model_kwargs={"n_layers": 2},
+                   ckpt_dir=root, dtype_policy="bf16", **kw)
+
+
+PROMPTS = [[int(x) for x in np.random.RandomState(s).randint(0, 256, n)]
+           for s, n in ((1, 6), (2, 11), (3, 14))]
+
+
+@pytest.mark.slow
+class TestHotSwap:
+    def test_startup_follows_pointer_not_latest(self, two_step_ckpt):
+        rec = publish.publish_step(two_step_ckpt, 1)
+        replica = _make_replica(two_step_ckpt)
+        # The pointer outranks "latest committed": step 2 exists, the
+        # publication names step 1, the replica serves step 1.
+        assert replica.restored_step == 1
+        assert replica.engine.weight_step == 1
+        assert replica.engine.weight_version == rec["version"]
+
+    def test_hot_swap_bitwise_vs_fresh_replica_zero_drops(
+            self, two_step_ckpt):
+        v1 = publish.publish_step(two_step_ckpt, 1)["version"]
+        replica = _make_replica(two_step_ckpt)
+        ref1 = {i: replica.generate(p, 4).tokens
+                for i, p in enumerate(PROMPTS)}
+        v2 = publish.publish_step(two_step_ckpt, 2,
+                                  note="nightly eval passed")["version"]
+        streams, errors = [], []
+
+        def traffic(pi):
+            try:
+                for _ in range(5):
+                    c = replica.generate(PROMPTS[pi], 4, rid=None)
+                    streams.append((pi, list(c.tokens)))
+            except Exception as e:   # noqa: BLE001 — any drop fails the pin
+                errors.append(e)
+
+        threads = [threading.Thread(target=traffic, args=(pi,))
+                   for pi in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        out = replica.hot_swap()
+        for t in threads:
+            t.join()
+        assert not errors, f"swap dropped traffic: {errors[0]!r}"
+        assert out["ok"] and out["from_version"] == v1
+        assert out["to_version"] == v2 and out["step"] == 2
+        assert replica.engine.weight_version == v2
+        assert replica.engine.weight_step == 2
+        assert replica.restored_step == 2
+        assert replica.engine.stats()["weight_swaps"] == 1.0
+        # THE acceptance pin: post-swap streams are bitwise the fresh
+        # replica's, restored from the same published manifest.
+        fresh = _make_replica(two_step_ckpt)
+        assert fresh.restored_step == 2
+        ref2 = {i: fresh.generate(p, 4).tokens
+                for i, p in enumerate(PROMPTS)}
+        assert ref2 != ref1          # the two manifests really differ
+        for i, p in enumerate(PROMPTS):
+            assert replica.generate(p, 4).tokens == ref2[i]
+        # Zero drops AND no mixed-version stream: every completion that
+        # rode through the window is wholly old-weights or wholly new.
+        assert len(streams) == 5 * len(PROMPTS)
+        for pi, toks in streams:
+            assert len(toks) == 4
+            assert toks in (ref1[pi], ref2[pi]), (
+                f"prompt {pi}: stream {toks} matches neither the "
+                f"pre-swap ({ref1[pi]}) nor post-swap ({ref2[pi]}) "
+                f"version — a mixed-version completion")
+
+    @pytest.mark.parametrize("site", ["swap_before_restore",
+                                      "swap_after_restore",
+                                      "swap_before_flip",
+                                      "swap_after_flip"])
+    def test_chaos_sweep_exactly_one_weight_version(
+            self, site, two_step_ckpt, monkeypatch):
+        v1 = publish.publish_step(two_step_ckpt, 1)["version"]
+        replica = _make_replica(two_step_ckpt)
+        t1 = {i: replica.generate(p, 3).tokens
+              for i, p in enumerate(PROMPTS[:2])}
+        v2 = publish.publish_step(two_step_ckpt, 2)["version"]
+
+        def hook(where):
+            raise _Crashed(where)
+
+        monkeypatch.setattr(chaos, "CRASH_HOOK", hook)
+        monkeypatch.setenv(chaos.ENV_CRASH, site)
+        with pytest.raises(_Crashed):
+            replica.hot_swap()
+        monkeypatch.delenv(chaos.ENV_CRASH)
+        # The engine is never left wedged mid-quiesce...
+        assert replica.engine.swapping is False
+        got = {i: replica.generate(p, 3).tokens
+               for i, p in enumerate(PROMPTS[:2])}
+        if site == "swap_after_flip":
+            # Crash AFTER the atomic flip: the new version committed.
+            assert replica.engine.weight_version == v2
+            fresh = _make_replica(two_step_ckpt)
+            assert got == {i: fresh.generate(p, 3).tokens
+                           for i, p in enumerate(PROMPTS[:2])}
+        else:
+            # Crash anywhere before: rolled back whole — the old
+            # version, bitwise.
+            assert replica.engine.weight_version == v1
+            assert replica.engine.weight_step == 1
+            assert got == t1
+
+    def test_swap_rpc_verb_and_stale_version_pin(self, two_step_ckpt):
+        publish.publish_step(two_step_ckpt, 1)
+        replica = _make_replica(two_step_ckpt)
+        handler = replica.rpc_handler()
+        rec = publish.publish_step(two_step_ckpt, 2)
+        out = handler.rpc_swap(version=rec["version"])
+        assert out["ok"] and out["to_version"] == rec["version"]
+        # A stale version pin (pointer moved past what the AM saw) is a
+        # typed refusal with the current weights kept.
+        publish.publish_step(two_step_ckpt, 1)
+        with pytest.raises(SwapError):
+            handler.rpc_swap(version=rec["version"])
+        assert replica.engine.weight_version == rec["version"]
+
+
+# ---------------------------------------------------------------------------
+# THE HEADLINE PIN: a routed 2-replica fleet rolls onto a new publication
+# one replica at a time — zero dropped requests, both replicas end
+# bitwise on the new manifest, the router's down-mark covers each window.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_rolling_fleet_swap_zero_drops(two_step_ckpt):
+    from tony_tpu.serve.router import RequestRouter
+
+    v1 = publish.publish_step(two_step_ckpt, 1)["version"]
+    replicas = {f"serve:{i}": _make_replica(two_step_ckpt)
+                for i in range(2)}
+    router = RequestRouter(block_size=8)
+    for name in replicas:
+        router.upsert_replica(name, address=f"fake:{name}")
+    ref1 = {i: replicas["serve:0"].generate(p, 3).tokens
+            for i, p in enumerate(PROMPTS)}
+    v2 = publish.publish_step(two_step_ckpt, 2)["version"]
+
+    stop = threading.Event()
+    streams, errors = [], []
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            pi = i % len(PROMPTS)
+            i += 1
+            try:
+                name = router.route(PROMPTS[pi])
+                c = replicas[name].generate(PROMPTS[pi], 3)
+                streams.append((pi, list(c.tokens)))
+            except Exception as e:   # noqa: BLE001 — drops fail the pin
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=traffic) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        # The AM's rolling tick, inline: one replica at a time, router
+        # down-marked for exactly the swap window.
+        ctl = FleetSwapController(timeout_s=120.0)
+        assert ctl.set_target(v2, 2)
+        while True:
+            fleet = [{"id": name, "version": r.engine.weight_version,
+                      "standby": False, "index": int(name.split(":")[1])}
+                     for name, r in replicas.items()]
+            name = ctl.next_replica(fleet)
+            if name is None:
+                break
+            router.retire_replica(name)        # the swap-window down-mark
+            ctl.begin(name)
+            out = replicas[name].hot_swap()
+            ctl.finish(name, out["ok"])
+            router.upsert_replica(name)        # heartbeat revival
+        assert ctl.swapped == 2 and ctl.failed == 0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, f"rolling swap dropped a request: {errors[0]!r}"
+    # Both replicas converged on v2 and serve bitwise-identical streams
+    # to a fresh replica restored from the same manifest.
+    fresh = _make_replica(two_step_ckpt)
+    ref2 = {i: fresh.generate(p, 3).tokens for i, p in enumerate(PROMPTS)}
+    for name, r in replicas.items():
+        assert r.engine.weight_version == v2, name
+        for i, p in enumerate(PROMPTS):
+            assert r.generate(p, 3).tokens == ref2[i], (name, i)
+    # Every in-window stream was wholly one version — never mixed.
+    assert streams, "traffic never landed"
+    for pi, toks in streams:
+        assert len(toks) == 3 and toks in (ref1[pi], ref2[pi]), (pi, toks)
+
+
+# ---------------------------------------------------------------------------
+# Router down-mark + session/heartbeat plumbing (jax-free)
+# ---------------------------------------------------------------------------
+
+class TestControlPlanePlumbing:
+    def test_router_retires_swapping_replica_and_revives(self):
+        from tony_tpu.serve.router import RequestRouter
+
+        rt = RequestRouter(block_size=16)
+
+        def infos(swapping):
+            m = {"rpc_port": 7001, "queue_depth": 0.0}
+            if swapping:
+                m["swapping"] = 1.0
+            return [{"job_type": "serve", "index": 0, "status": "RUNNING",
+                     "host": "h0", "serve_metrics": m}]
+
+        rt.refresh_from_task_infos(infos(False))
+        assert [v.retired for v in rt.replicas()] == [False]
+        rt.refresh_from_task_infos(infos(True))
+        assert [v.retired for v in rt.replicas()] == [True]
+        # The post-flip republish clears the flag; the next beat revives.
+        rt.refresh_from_task_infos(infos(False))
+        assert [v.retired for v in rt.replicas()] == [False]
+
+    def test_session_heartbeat_carries_publication(self):
+        from tony_tpu.conf import TonyConfig
+        from tony_tpu.session import TonySession
+
+        s = TonySession(TonyConfig({"tony.worker.instances": "1"}),
+                        app_id="app_pub")
+        s.on_registered("worker", 0, "h0", 4000)
+        s.on_heartbeat("worker", 0, published={"version": 3, "step": 40})
+        t = s.task("worker", 0)
+        assert t.published == {"version": 3, "step": 40}
+        assert t.to_info()["published"] == {"version": 3, "step": 40}
+        # Malformed publication news is advisory, never liveness-fatal.
+        s.on_heartbeat("worker", 0, published={"version": "x"})
+        assert s.task("worker", 0).published == {"version": 3, "step": 40}
+
+
+# ---------------------------------------------------------------------------
+# jhist: the PUBLISH→SWAP timeline, bill --json/--csv --since/--until
+# ---------------------------------------------------------------------------
+
+class TestHistoryPlane:
+    def test_publish_swap_events_rotation_proof(self):
+        assert ev.PUBLISH not in ev._HIGH_RATE
+        assert ev.SWAP not in ev._HIGH_RATE
+
+    @pytest.fixture
+    def pub_jhist(self, tmp_path, monkeypatch):
+        clock = {"t": 1000.0}
+        monkeypatch.setattr(
+            ev, "time", types.SimpleNamespace(time=lambda: clock["t"]))
+        from tony_tpu.conf import SERVE_QOS_TENANTS
+
+        handler = ev.EventHandler(
+            tmp_path, "app_pub_hist",
+            conf_snapshot={SERVE_QOS_TENANTS: "gold:2"})
+        handler.task_started("serve", 0, "host0")
+        for t, rate in ((1000.0, 100.0), (1010.0, 100.0), (1020.0, 0.0)):
+            clock["t"] = t
+            handler.serve_window(
+                "serve", 0,
+                {"tenants": {"gold": {"tokens_per_s": rate}}})
+        handler.publish(1, 5, note="nightly")
+        handler.swap("serve", 1, 0, 1, 5, 2.5, True)
+        handler.swap("serve", 0, 0, 1, 5, 130.0, False,
+                     detail="swap RPC timed out")
+        handler.application_finished("SUCCEEDED", "")
+        handler.close()
+        return tmp_path
+
+    def test_timeline_reconstructs_from_history(self, pub_jhist):
+        jobs = history.gather_jobs(pub_jhist)
+        detail = history.job_detail(jobs[0])
+        assert [p["version"] for p in detail["publications"]] == [1]
+        assert [(s["index"], s["ok"]) for s in detail["swaps"]] == [
+            (1, True), (0, False)]
+        text = history.render_show(detail)
+        assert "publication timeline:" in text
+        assert "PUBLISH v1" in text and "step 5" in text
+        assert "SWAP serve:1 v0→v1" in text
+        assert "FAILED" in text and "swap RPC timed out" in text
+        page = history._job_page(detail)
+        assert "Publication timeline" in page
+
+    def test_bill_window_clips_before_rollup(self, pub_jhist):
+        jobs = history.gather_jobs(pub_jhist)
+        # Full ledger: 100 tok/s × 20 s = 2000 tokens, weight 2.
+        rows = history.bill_rows(jobs)
+        assert rows == [{"app_id": "app_pub_hist", "tenant": "gold",
+                         "tokens": pytest.approx(2000.0), "weight": 2.0,
+                         "billed": pytest.approx(4000.0)}]
+        # since drops the first window, until the last — half each.
+        assert history.bill_rows(jobs, since=1005.0)[0]["tokens"] == \
+            pytest.approx(1000.0)
+        assert history.bill_rows(jobs, until=1015.0)[0]["tokens"] == \
+            pytest.approx(1000.0)
+        assert history.bill_rows(jobs, "nobody") == []
+
+    def test_bill_cli_json_csv_and_parse_when(self, pub_jhist, capsys):
+        args = types.SimpleNamespace(action="bill", app_id=None,
+                                     history_dir=str(pub_jhist),
+                                     json=True, csv=False,
+                                     since=None, until="1015")
+        assert history.main(args) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert rows[0]["tenant"] == "gold"
+        assert rows[0]["tokens"] == pytest.approx(1000.0)
+        args.json, args.csv = False, True
+        assert history.main(args) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out[0] == "app_id,tenant,tokens,weight,billed"
+        assert out[1] == "app_pub_hist,gold,1000,2,2000"
+        # Unparseable window: usage error, not a stack trace.
+        args.until = "last tuesday"
+        assert history.main(args) == 2
+        assert "unparseable" in capsys.readouterr().out
+        assert history.parse_when(None) is None
+        assert history.parse_when("1015.5") == 1015.5
+        assert history.parse_when("2026-08-07") == time.mktime(
+            time.strptime("2026-08-07", "%Y-%m-%d"))
+
+
+# ---------------------------------------------------------------------------
+# tony aot gc + the CLI front doors
+# ---------------------------------------------------------------------------
+
+class TestAotGc:
+    RT = {"jax": "0.9.9", "backend": "cpu", "n_devices": 1}
+
+    def _entry(self, root, name, fp):
+        d = root / name
+        d.mkdir(parents=True)
+        (d / "entry.json").write_text(json.dumps({"fingerprint": fp}))
+        (d / "prog.bin").write_bytes(b"x" * 64)
+
+    def test_gc_drops_only_unhittable_entries(self, tmp_path):
+        from tony_tpu.ckpt.aot import AOTCache
+
+        cache = AOTCache(str(tmp_path / "aot"))
+        root = Path(cache.root)
+        # Live: runtime matches — OTHER geometry/model is kept (that is
+        # what a shared cache is FOR).
+        self._entry(root, "aot_live1", {**self.RT, "kind": "decode"})
+        self._entry(root, "aot_live2", {**self.RT, "kind": "prefill",
+                                        "mesh": "fsdp4"})
+        # Stranded: a runtime no live config can reproduce.
+        self._entry(root, "aot_stale", {**self.RT, "jax": "0.1.0"})
+        # Torn: unreadable entry.json == unhittable.
+        (root / "aot_torn").mkdir()
+        (root / "aot_torn" / "entry.json").write_text("{ half")
+        # A crashed writer's staging dir is always reclaimed.
+        self._entry(root, "aot_x.tmp123", {**self.RT})
+        dropped, kept, freed = cache.gc(dry_run=True, runtime=self.RT)
+        assert (dropped, kept) == (3, 2) and freed > 0
+        assert sorted(p.name for p in root.iterdir() if
+                      p.name.startswith("aot_")) == [
+            "aot_live1", "aot_live2", "aot_stale", "aot_torn",
+            "aot_x.tmp123"]          # dry run deleted nothing
+        dropped, kept, freed2 = cache.gc(runtime=self.RT)
+        assert (dropped, kept) == (3, 2) and freed2 == freed
+        assert sorted(p.name for p in root.iterdir() if
+                      p.name.startswith("aot_")) == [
+            "aot_live1", "aot_live2"]
+        # Idempotent: a second pass finds nothing stranded.
+        assert cache.gc(runtime=self.RT) == (0, 2, 0)
+
+
+class TestCli:
+    def test_tony_publish(self, tmp_path, capsys):
+        from tony_tpu.cli import main as cli_main
+
+        root = tmp_path / "ckpt"
+        commit_fake_steps(root, 4)
+        assert cli_main(["publish", str(root)]) == 0
+        assert "published v1 -> step 4" in capsys.readouterr().out
+        assert cli_main(["publish", str(root), "--step", "9"]) == 1
+        assert "not committed" in capsys.readouterr().out
+        rec = publish.latest_publication(root)
+        assert (rec["version"], rec["step"]) == (1, 4)
+
+    def test_tony_aot_gc(self, tmp_path, capsys):
+        from tony_tpu.cli import main as cli_main
+
+        cache_dir = tmp_path / "aot"
+        (cache_dir / "aot_orphan.tmp1").mkdir(parents=True)
+        assert cli_main(["aot", "gc", "--cache", str(cache_dir),
+                         "--dry-run"]) == 0
+        assert "would drop 1" in capsys.readouterr().out
+        assert (cache_dir / "aot_orphan.tmp1").is_dir()
+        assert cli_main(["aot", "gc", "--cache", str(cache_dir)]) == 0
+        assert "dropped 1" in capsys.readouterr().out
+        assert not (cache_dir / "aot_orphan.tmp1").exists()
+
+    def test_tony_serve_follow_resolves_ckpt_dir(self, tmp_path,
+                                                 monkeypatch):
+        import tony_tpu.client as client_mod
+        from tony_tpu import conf as conf_mod
+        from tony_tpu import constants
+        from tony_tpu.cli import cmd_serve, make_parser
+        from tony_tpu.conf import TonyConfig
+
+        captured = {}
+
+        class _FakeClient:
+            def __init__(self, cfg, **kw):
+                captured["cfg"] = cfg
+
+            def run(self, timeout=None):
+                return 0
+
+        monkeypatch.setattr(client_mod, "TonyClient", _FakeClient)
+        # --follow a JOB DIR: the followed train job's conf supplies the
+        # ckpt root the publications land in, and follow mode is armed.
+        jobdir = tmp_path / "job"
+        jobdir.mkdir()
+        ckpt = tmp_path / "ckpt"
+        TonyConfig({conf_mod.CKPT_DIR: str(ckpt)}).save(
+            jobdir / constants.TONY_JOB_JSON)
+        args = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--follow", str(jobdir)])
+        assert args.fn(args) == 0
+        cfg = captured["cfg"]
+        assert cfg.get(conf_mod.PUBLISH_FOLLOW) == "true"
+        assert cfg.get(conf_mod.SERVE_CKPT_DIR) == str(ckpt.resolve())
+        # A bare ckpt dir (no job conf inside) follows directly.
+        args = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--follow", str(ckpt)])
+        assert args.fn(args) == 0
+        assert captured["cfg"].get(conf_mod.SERVE_CKPT_DIR) == \
+            str(ckpt.resolve())
+        # A jobdir whose conf names no ckpt dir is a clean usage error.
+        empty = tmp_path / "job2"
+        empty.mkdir()
+        TonyConfig({}).save(empty / constants.TONY_JOB_JSON)
+        args = make_parser().parse_args([
+            "serve", "--model", "llama-tiny", "--follow", str(empty)])
+        with pytest.raises(SystemExit, match="nothing to"):
+            cmd_serve(args)
+        # Neither --ckpt_dir nor --follow: same.
+        args = make_parser().parse_args(["serve", "--model", "llama-tiny"])
+        with pytest.raises(SystemExit, match="--ckpt_dir"):
+            cmd_serve(args)

@@ -860,7 +860,7 @@ class TestRoutedServing:
                 self.front = front
 
             def rpc_generate(self, tokens, max_new_tokens=16, rid=None,
-                             conv=None):
+                             conv=None, tenant=None):
                 c = self.front.generate(tokens, max_new_tokens, rid=rid)
                 return {"rid": c.rid, "tokens": c.tokens,
                         "latency_ms": round(1e3 * c.latency_s, 3)}

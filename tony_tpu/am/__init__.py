@@ -672,6 +672,121 @@ class ApplicationMaster:
                   f"{len(warm)} warm)")
         return True
 
+    def _tick_publication(self, session: TonySession) -> None:
+        """Continuous weight publication (tony_tpu.publish /
+        serve.swap): watch for a new published manifest and roll the
+        serve fleet onto it, ONE replica at a time.
+
+        Target discovery is two-source: the train gang's heartbeats
+        carry the publication they staged (``task.published`` — the
+        colocated train+serve job needs no extra wiring), and a
+        ``tony.publish.follow`` job additionally polls the pointer file
+        directly (throttled to ~1s — a follower fleet has no train
+        tasks to hear it from). A new target emits ONE PUBLISH event
+        and arms the :class:`~tony_tpu.serve.swap.FleetSwapController`;
+        each tick then asks the controller who (if anyone) to swap —
+        warm standbys first, then actives by index — down-marks that
+        replica in place (``swapping=1.0``, the `_promote_standby`
+        idiom, so serve_endpoints carries the retire signal THIS tick)
+        and fires the ``swap`` RPC on a named daemon thread: the
+        monitor loop never blocks on a restore. Each attempt's outcome
+        lands as one SWAP event; a failure cools the controller down
+        before the next try, and a wedged RPC is reaped at the
+        configured timeout."""
+        if self.handler is None or not self.handler._all_registered_fired:
+            return
+        serve_jts = session.serve_job_types()
+        if not serve_jts:
+            return
+        from tony_tpu.serve.swap import FleetSwapController
+
+        if not hasattr(self, "_swap_ctl"):
+            self._swap_ctl = FleetSwapController(
+                timeout_s=self.conf.get_int(
+                    conf_mod.PUBLISH_SWAP_TIMEOUT_MS, 120000) / 1e3)
+            self._pub_poll_t = 0.0
+        ctl = self._swap_ctl
+        best: Optional[tuple] = None
+        for t in session.tasks():
+            pub = getattr(t, "published", None)
+            if pub and (best is None or pub["version"] > best[0]):
+                best = (pub["version"], pub["step"])
+        if self.conf.get_bool(conf_mod.PUBLISH_FOLLOW, False):
+            now = time.monotonic()
+            if now - self._pub_poll_t >= 1.0:
+                self._pub_poll_t = now
+                ckpt_dir = (self.conf.get(conf_mod.SERVE_CKPT_DIR)
+                            or self.conf.get(conf_mod.CKPT_DIR))
+                if ckpt_dir:
+                    from tony_tpu.publish import latest_publication
+                    rec = latest_publication(ckpt_dir)
+                    if rec and (best is None or rec["version"] > best[0]):
+                        best = (rec["version"], rec["step"])
+        if best is not None and ctl.set_target(*best):
+            self._log(f"publication v{best[0]} (step {best[1]}) -> "
+                      f"rolling fleet swap")
+            if self.events is not None:
+                self.events.publish(best[0], best[1])
+        if ctl.target is None:
+            return
+        wedged = ctl.check_timeout()
+        if wedged is not None:
+            self._log(f"swap of {wedged[0]}:{wedged[1]} timed out after "
+                      f"{ctl.timeout_s:.0f}s")
+            if self.events is not None:
+                self.events.swap(wedged[0], wedged[1], 0, ctl.target[0],
+                                 ctl.target[1], ctl.timeout_s, False,
+                                 "swap RPC timed out")
+        fleet = []
+        by_id: Dict[tuple, object] = {}
+        for t in session.tasks():
+            m = t.serve_metrics
+            if t.job_type not in serve_jts or t.status.is_terminal \
+                    or not t.host or not m.get("rpc_port"):
+                continue
+            rid = (t.job_type, t.index)
+            by_id[rid] = t
+            fleet.append({"id": rid,
+                          "version": int(m.get("weight_version", 0) or 0),
+                          "standby": bool(m.get("warm_standby")),
+                          "index": t.index})
+        rid = ctl.next_replica(fleet)
+        if rid is None:
+            return
+        task = by_id[rid]
+        to_version, to_step = ctl.target
+        from_version = int(task.serve_metrics.get("weight_version", 0)
+                           or 0)
+        addr = f"{task.host}:{int(task.serve_metrics['rpc_port'])}"
+        # Down-mark in place: the router's next endpoints poll retires
+        # this replica for the window; the replica's own post-swap
+        # stats republish (swapping back to 0) revives it.
+        task.serve_metrics = dict(task.serve_metrics, swapping=1.0)
+        ctl.begin(rid)
+        self._log(f"swap {task.task_id} v{from_version} -> v{to_version} "
+                  f"(step {to_step})")
+
+        def attempt() -> None:
+            from tony_tpu.rpc import RpcClient, RpcError
+
+            t0 = time.monotonic()
+            ok, detail = True, ""
+            try:
+                with RpcClient(addr, timeout=ctl.timeout_s) as client:
+                    client.call("swap", version=to_version, step=to_step)
+            except (OSError, ValueError, RpcError) as e:
+                ok, detail = False, str(e)
+            ctl.finish(rid, ok)
+            if self.events is not None:
+                self.events.swap(rid[0], rid[1], from_version, to_version,
+                                 to_step, time.monotonic() - t0, ok,
+                                 detail)
+            self._log(f"swap {task.task_id} -> v{to_version} "
+                      + ("ok" if ok else f"FAILED ({detail})"))
+
+        threading.Thread(target=attempt, daemon=True,
+                         name=f"tony-swap-{task.task_id}").start()
+
     def _collect_traces_later(self, session: TonySession,
                               delay_s: float) -> None:
         """Wait for the executors' profiler endpoints to arrive (they're
@@ -807,6 +922,7 @@ class ApplicationMaster:
                 self._tick_resize(session)
                 self._log_history_events(session)
                 self._autoscale_serve(session)
+                self._tick_publication(session)
                 self._maybe_refresh_credentials()
 
                 if self._stop_reason is not None:

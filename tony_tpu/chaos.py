@@ -21,7 +21,14 @@ and the production code paths fire it at the instrumented sites —
   (:func:`crash_point`); the history-plane rotation path declares
   ``rotate_before_stage`` / ``rotate_after_stage`` / ``rotate_after_replace``
   so the stage-and-rename sweep can prove "old log or new log, never a
-  torn file". (Checkpoint commits keep their original
+  torn file"; the continuous-publication plane declares
+  ``publish_before_stage`` / ``publish_after_stage`` /
+  ``publish_after_replace`` around the pointer-file commit
+  (:func:`tony_tpu.publish.publish_step`) and ``swap_before_restore`` /
+  ``swap_after_restore`` / ``swap_before_flip`` / ``swap_after_flip``
+  around a replica's hot swap (:meth:`tony_tpu.serve.replica.Replica.
+  hot_swap`) so the sweep can prove "old weights or new weights, never
+  a mixed-version replica". (Checkpoint commits keep their original
   ``TONY_CKPT_CRASH`` phases.)
 
 Every probe is a cheap env read that no-ops when unarmed — an unarmed

@@ -296,3 +296,61 @@ class AOTCache:
         _fsync_dir(self.root)
         self.puts += 1
         return True
+
+    # -- maintenance (tony aot gc) -----------------------------------------
+    def gc(self, *, dry_run: bool = False,
+           runtime: Optional[Dict[str, Any]] = None) -> tuple:
+        """Drop entries no live config can produce. The criterion is the
+        RUNTIME half of the fingerprint (:func:`runtime_fingerprint`):
+        an entry whose stored jax/jaxlib/backend/device/XLA-flags tuple
+        differs from this process's can never hit again — ``get``
+        compares the full fingerprint and the runtime fields come from
+        the environment, not the caller — so it is stranded disk, not a
+        cache. Geometry/model variation is NOT a drop criterion: other
+        topologies of the live runtime are exactly what the cache is
+        for. Unreadable entries (torn by an unclean kill before the
+        rename discipline, or hand-damaged) are stranded the same way
+        and drop too. Staging ``.tmp`` orphans are always reclaimed.
+
+        Returns ``(dropped, kept, freed_bytes)``. ``dry_run`` reports
+        without deleting; ``runtime`` overrides the live fingerprint
+        (tests)."""
+        if runtime is None:
+            runtime = runtime_fingerprint()   # lazy jax import
+        rt_keys = sorted(runtime)
+
+        def _size(d: Path) -> int:
+            try:
+                return sum(f.stat().st_size for f in d.rglob("*")
+                           if f.is_file())
+            except OSError:
+                return 0
+
+        dropped, kept, freed = 0, 0, 0
+        for name in sorted(os.listdir(self.root)):
+            if not name.startswith(_PREFIX):
+                continue
+            d = self.root / name
+            if TMP_SUFFIX in name:
+                # A crashed writer's staging dir: never addressable.
+                freed += _size(d)
+                dropped += 1
+                if not dry_run:
+                    shutil.rmtree(d, ignore_errors=True)
+                continue
+            try:
+                with open(d / "entry.json") as f:
+                    fp = json.load(f).get("fingerprint") or {}
+                stale = any(fp.get(k) != runtime[k] for k in rt_keys)
+            except (OSError, ValueError):
+                stale = True          # unreadable = unhittable
+            if stale:
+                freed += _size(d)
+                dropped += 1
+                if not dry_run:
+                    shutil.rmtree(d, ignore_errors=True)
+            else:
+                kept += 1
+        if dropped and not dry_run:
+            _fsync_dir(self.root)
+        return dropped, kept, freed

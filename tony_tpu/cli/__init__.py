@@ -130,8 +130,33 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.model_kwargs:
         json_mod.loads(args.model_kwargs)   # validate at submit, not launch
         cfg.set(conf_mod.SERVE_MODEL_KWARGS, args.model_kwargs)
+    # Continuous publication follow mode (tony_tpu.publish): --follow
+    # names a TRAIN job's dir (its serialized conf supplies the ckpt
+    # dir) or a bare ckpt dir, and arms tony.publish.follow — the AM
+    # polls the published pointer and rolls the fleet onto every new
+    # version the train gang commits.
+    ckpt_dir = args.ckpt_dir
+    if getattr(args, "follow", None):
+        from tony_tpu import constants
+
+        followed = Path(args.follow).resolve()
+        conf_path = followed / constants.TONY_JOB_JSON
+        if conf_path.is_file():
+            followed_ckpt = TonyConfig.load(conf_path).get(
+                conf_mod.CKPT_DIR)
+            if not followed_ckpt:
+                raise SystemExit(
+                    f"--follow: job at {followed} has no "
+                    f"{conf_mod.CKPT_DIR} in its conf — nothing to "
+                    f"follow")
+            ckpt_dir = followed_ckpt
+        else:
+            ckpt_dir = str(followed)   # bare ckpt dir
+        cfg.set(conf_mod.PUBLISH_FOLLOW, "true")
+    if not ckpt_dir:
+        raise SystemExit("need --ckpt_dir (or --follow <jobdir>)")
     # Absolute: replicas run with a different cwd.
-    cfg.set(conf_mod.SERVE_CKPT_DIR, str(Path(args.ckpt_dir).resolve()))
+    cfg.set(conf_mod.SERVE_CKPT_DIR, str(Path(ckpt_dir).resolve()))
     cfg.set(conf_mod.SERVE_DTYPE_POLICY, args.dtype_policy)
     cfg.set(conf_mod.SERVE_CTX_MAX, str(args.ctx_max))
     if args.mesh:
@@ -296,6 +321,48 @@ def cmd_route(args: argparse.Namespace) -> int:
         pass
     finally:
         server.stop()
+    return 0
+
+
+def cmd_publish(args: argparse.Namespace) -> int:
+    """Publish a committed checkpoint step for serve fleets to hot-swap
+    onto (tony_tpu.publish): stage-and-rename the versioned pointer
+    file over the ckpt root. Jax-free — runs anywhere the ckpt dir is
+    mounted; the train loop's ``publish_every`` knob does the same
+    thing automatically on the save cadence."""
+    from tony_tpu.publish import PublishError, latest_publication, \
+        publish_step
+
+    try:
+        rec = publish_step(args.ckpt_dir, args.step,
+                           note=args.note or "")
+    except (PublishError, OSError) as e:
+        print(f"tony publish: {e}")
+        return 1
+    print(f"published v{rec['version']} -> step {rec['step']} "
+          f"({rec['manifest']})")
+    prev = latest_publication(args.ckpt_dir)
+    if prev is None or prev["version"] != rec["version"]:
+        print("warning: pointer read-back disagrees — concurrent "
+              "publisher?")
+    return 0
+
+
+def cmd_aot(args: argparse.Namespace) -> int:
+    """AOT-cache maintenance. ``gc`` drops entries whose stored runtime
+    fingerprint no live config can produce — a jax/backend upgrade
+    strands every old entry (the get() path already refuses them);
+    this reclaims the disk."""
+    if args.action != "gc":
+        return 2
+    from tony_tpu.ckpt.aot import AOTCache
+
+    cache = AOTCache(args.cache)
+    dropped, kept, freed = cache.gc(dry_run=args.dry_run)
+    verb = "would drop" if args.dry_run else "dropped"
+    print(f"tony aot gc: {verb} {dropped} stale entr"
+          f"{'y' if dropped == 1 else 'ies'} ({freed} bytes), "
+          f"{kept} live kept under {args.cache}")
     return 0
 
 
@@ -520,8 +587,15 @@ def make_parser() -> argparse.ArgumentParser:
                     help="registered model name (e.g. llama2-7b)")
     sv.add_argument("--model_kwargs", help="JSON dict of model kwargs "
                     "(quant lanes, layer count overrides, ...)")
-    sv.add_argument("--ckpt_dir", required=True,
-                    help="training checkpoint directory to serve")
+    sv.add_argument("--ckpt_dir", default=None,
+                    help="training checkpoint directory to serve "
+                         "(or use --follow)")
+    sv.add_argument("--follow", default=None, metavar="JOBDIR|CKPT_DIR",
+                    help="follow a train job's continuous publications: "
+                         "a job dir (its conf supplies the ckpt dir) or "
+                         "a bare ckpt dir — the AM polls the published "
+                         "pointer and hot-swaps the fleet onto every "
+                         "new version, one replica at a time")
     sv.add_argument("--replicas", type=int, default=1,
                     help="initial replica count (the autoscale floor)")
     sv.add_argument("--max_replicas", type=int, default=None,
@@ -659,7 +733,37 @@ def make_parser() -> argparse.ArgumentParser:
     h.add_argument("--bind", default="127.0.0.1",
                    help="portal bind address (default loopback; job configs "
                         "are exposed unauthenticated — widen deliberately)")
+    h.add_argument("--json", action="store_true",
+                   help="emit the billing rows as JSON (for bill)")
+    h.add_argument("--csv", action="store_true",
+                   help="emit the billing rows as CSV (for bill)")
+    h.add_argument("--since", default=None, metavar="WHEN",
+                   help="clip the billing window start: epoch seconds, "
+                        "YYYY-MM-DD, or 'YYYY-MM-DD HH:MM:SS' (for bill)")
+    h.add_argument("--until", default=None, metavar="WHEN",
+                   help="clip the billing window end (same formats; "
+                        "for bill)")
     h.set_defaults(fn=cmd_history)
+
+    pb = sub.add_parser("publish", help="publish a committed checkpoint "
+                        "step for serve fleets to hot-swap onto")
+    pb.add_argument("ckpt_dir", help="checkpoint root (the train job's "
+                    "tony.ckpt.dir)")
+    pb.add_argument("--step", type=int, default=None,
+                    help="committed step to publish (default: newest)")
+    pb.add_argument("--note", default="",
+                    help="free-form note recorded in the pointer")
+    pb.set_defaults(fn=cmd_publish)
+
+    ao = sub.add_parser("aot", help="AOT compile-cache maintenance")
+    ao.add_argument("action", choices=["gc"],
+                    help="gc: drop entries whose runtime fingerprint no "
+                         "live config can produce")
+    ao.add_argument("--cache", required=True, metavar="DIR",
+                    help="AOT cache directory")
+    ao.add_argument("--dry-run", dest="dry_run", action="store_true",
+                    help="report what would be dropped, delete nothing")
+    ao.set_defaults(fn=cmd_aot)
 
     n = sub.add_parser("notebook", help="run a notebook/command in one "
                        "container behind a TCP proxy")

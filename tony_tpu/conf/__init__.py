@@ -199,6 +199,22 @@ RESIZE_MAX_RESIZES = "tony.resize.max-resizes"      # per-job resize budget
 RESIZE_DRAIN_TIMEOUT_MS = "tony.resize.drain-timeout-ms"
 RESIZE_REGANG_TIMEOUT_MS = "tony.resize.regang-timeout-ms"
 RESIZE_RESTORE_TIMEOUT_MS = "tony.resize.restore-timeout-ms"
+
+# Continuous weight publication (tony_tpu.publish / serve.swap): with
+# publish.every > 0, JAXRuntime exports TONY_PUBLISH_EVERY and the train
+# loop advances the ckpt root's published.json pointer every N committed
+# saves (stage-and-rename, announced on the heartbeat). publish.follow
+# = true arms the AM's rolling fleet swap: when a newer pointer version
+# appears (heartbeat or a direct ckpt-dir read), serve replicas hot-swap
+# to it one at a time, down-marked in the router for their swap window.
+PUBLISH_EVERY = "tony.publish.every"                # saves/publication (0=off)
+PUBLISH_FOLLOW = "tony.publish.follow"              # AM swaps the fleet
+PUBLISH_SWAP_TIMEOUT_MS = "tony.publish.swap-timeout-ms"  # per-replica window
+# Shared per-gang train-side AOT cache dir (the serve cold-start plane's
+# train half): one worker pays the accum-step trace+compile per (mesh,
+# geometry) fingerprint, the rest of the gang — and every post-resize
+# re-gang — deserializes. Exported to jax tasks as TONY_TRAIN_AOT_CACHE.
+TRAIN_AOT_CACHE = "tony.train.aot-cache"            # cache dir ("" = off)
 # link (default): per-container venv localization hardlinks file content —
 # metadata-only, but containers ALIAS the staged inodes, so a job that
 # rewrites venv files IN PLACE (r+ open, forced reinstall reusing inodes)
@@ -280,6 +296,10 @@ DEFAULTS: Dict[str, str] = {
     RESIZE_DRAIN_TIMEOUT_MS: "60000",
     RESIZE_REGANG_TIMEOUT_MS: "120000",
     RESIZE_RESTORE_TIMEOUT_MS: "120000",
+    PUBLISH_EVERY: "0",
+    PUBLISH_FOLLOW: "false",
+    PUBLISH_SWAP_TIMEOUT_MS: "120000",
+    TRAIN_AOT_CACHE: "",
 }
 
 

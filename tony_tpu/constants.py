@@ -61,6 +61,15 @@ ENV_SERVE_STATS = "TONY_SERVE_STATS"
 # executor creates the file, and train_loop — polling it between steps —
 # commits model+data-cursor and exits EXIT_DRAINED.
 ENV_DRAIN_FILE = "TONY_DRAIN_FILE"
+# Continuous weight publication (tony_tpu.publish): JAXRuntime exports
+# tony.publish.every; train_loop advances the ckpt root's published.json
+# pointer every N committed periodic saves, and the executor's heartbeat
+# loop reads the pointer (jax-free) and announces it to the AM.
+ENV_PUBLISH_EVERY = "TONY_PUBLISH_EVERY"
+# Shared per-gang train AOT cache dir (tony_tpu.ckpt.aot): exported from
+# tony.train.aot-cache; make_accum_train_step deserializes a gang mate's
+# compiled step instead of re-tracing (first writer wins on populate).
+ENV_TRAIN_AOT_CACHE = "TONY_TRAIN_AOT_CACHE"
 
 # TFRuntime / PyTorchRuntime / HorovodRuntime / MXNetRuntime rendezvous vars
 ENV_TF_CONFIG = "TF_CONFIG"

@@ -61,6 +61,14 @@ SERVE_WINDOW = "SERVE_WINDOW"
 TRAIN_STEP = "TRAIN_STEP"
 SCALE_DECISION = "SCALE_DECISION"
 RESIZE = "RESIZE"
+# Continuous weight publication (tony_tpu.publish / serve.swap): one
+# PUBLISH per new manifest pointer the train gang stages, one SWAP per
+# replica the AM rolls onto it — together the timeline `tony history`
+# reconstructs (which version, which step, who swapped when, how long
+# each swap window lasted). Low-rate lifecycle records: NEVER rotation
+# victims.
+PUBLISH = "PUBLISH"
+SWAP = "SWAP"
 
 _METADATA = "METADATA"
 
@@ -238,6 +246,29 @@ class EventHandler:
                   job_type=job_type, old_workers=int(old_workers),
                   new_workers=int(new_workers), wall_s=float(wall_s),
                   ok=bool(ok), detail=detail)
+
+    def publish(self, version: int, step: int, note: str = "") -> None:
+        """One new weight publication became the fleet's swap target
+        (tony_tpu.publish): the version the pointer file minted and the
+        committed checkpoint step it names. Emitted by the AM when its
+        publication tick first observes the version — exactly once per
+        version, however many heartbeats carry it."""
+        self.emit(PUBLISH, version=int(version), step=int(step),
+                  note=str(note))
+
+    def swap(self, job_type: str, index: int, from_version: int,
+             to_version: int, step: int, wall_s: float, ok: bool,
+             detail: str = "") -> None:
+        """One replica's hot-swap outcome (tony_tpu.serve.swap): which
+        versions it rolled between, the step restored, and the wall
+        seconds of the whole window (restore + quiesce + flip) — the
+        number ROOFLINE §16's swap-window model predicts. ok=False
+        records a rolled-back attempt: the replica kept serving
+        from_version."""
+        self.emit(SWAP, job_type=job_type, index=int(index),
+                  from_version=int(from_version),
+                  to_version=int(to_version), step=int(step),
+                  wall_s=float(wall_s), ok=bool(ok), detail=detail)
 
     def close(self) -> None:
         """Finalize: move intermediate → finished (the reference's HDFS

@@ -361,6 +361,24 @@ class TaskExecutor:
             except Exception:   # noqa: BLE001 — advisory telemetry only
                 return None
 
+        def published() -> Optional[Dict[str, object]]:
+            # Publication pointer announcement (tony_tpu.publish): the
+            # beat carries the ckpt root's published.json version/step
+            # so the AM's rolling fleet swap learns of a new pointer
+            # from ANY gang member's heartbeat — no extra RPC, no AM
+            # filesystem dependency. latest_publication is jax-free and
+            # failure-silent by contract, same as the ckpt_step scan.
+            if not ckpt_dir:
+                return None
+            try:
+                from tony_tpu.publish import latest_publication
+                rec = latest_publication(ckpt_dir)
+                if rec is None:
+                    return None
+                return {"version": rec["version"], "step": rec["step"]}
+            except Exception:   # noqa: BLE001 — advisory telemetry only
+                return None
+
         failures = 0
         try:
             while not self._hb_stop.wait(interval_s):
@@ -377,6 +395,9 @@ class TaskExecutor:
                         extras["ckpt_step"] = step
                     if serve is not None:
                         extras["serve"] = serve
+                    pub = published()
+                    if pub is not None:
+                        extras["published"] = pub
                     resp = hb_client.call("heartbeat", job_type=self.job_type,
                                           index=self.index, **extras)
                     failures = 0

@@ -198,6 +198,14 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
     # preemption's wall time went.
     resizes = [dict(r["payload"], timestamp=r["timestamp"])
                for r in records if r["type"] == ev.RESIZE]
+    # Continuous publication timeline (PR 20): PUBLISH (a new manifest
+    # pointer became the fleet target) interleaved with the per-replica
+    # SWAP outcomes — together they reconstruct which version each
+    # replica served when, and what every swap window cost.
+    publications = [dict(r["payload"], timestamp=r["timestamp"])
+                    for r in records if r["type"] == ev.PUBLISH]
+    swaps = [dict(r["payload"], timestamp=r["timestamp"])
+             for r in records if r["type"] == ev.SWAP]
     serve_windows = {tid: _downsample(s) for tid, s in serve_windows.items()}
     train_steps = {tid: _downsample(s) for tid, s in train_steps.items()}
     # Per-tenant SLO rollup from each task's NEWEST window (qps/queued/
@@ -246,6 +254,8 @@ def job_detail(job: Dict[str, Any]) -> Dict[str, Any]:
         "tenant_slo": tenant_slo,
         "billing": billing_rollup(records, meta.get("config")),
         "resizes": resizes,
+        "publications": publications,
+        "swaps": swaps,
         "scale_decisions": scale_decisions,
         "scale_replay": scale_replay,
         "traces": list_traces(history_root, job["app_id"]),
@@ -333,6 +343,26 @@ def render_show(detail: Dict[str, Any]) -> str:
                        f"{p.get('old_workers')}→{p.get('new_workers')} "
                        f"{float(p.get('wall_s', 0.0)):.2f}s [{mark}]"
                        + (f" — {p['detail']}" if p.get("detail") else ""))
+    if detail.get("publications") or detail.get("swaps"):
+        out.append("  publication timeline:")
+        merged = sorted(
+            [("PUBLISH", p) for p in detail.get("publications", [])]
+            + [("SWAP", p) for p in detail.get("swaps", [])],
+            key=lambda kp: kp[1]["timestamp"])
+        for kind, p in merged:
+            when = time.strftime("%H:%M:%S", time.localtime(p["timestamp"]))
+            if kind == "PUBLISH":
+                out.append(f"    {when} PUBLISH v{p.get('version')} "
+                           f"(step {p.get('step')})"
+                           + (f" — {p['note']}" if p.get("note") else ""))
+            else:
+                mark = "ok" if p.get("ok") else "FAILED"
+                out.append(f"    {when} SWAP {p.get('job_type')}:"
+                           f"{p.get('index')} "
+                           f"v{p.get('from_version')}→v{p.get('to_version')} "
+                           f"(step {p.get('step')}) "
+                           f"{float(p.get('wall_s', 0.0)):.2f}s [{mark}]"
+                           + (f" — {p['detail']}" if p.get("detail") else ""))
     if detail.get("billing"):
         out.append("  billing (tokens × weight, integrated over windows):")
         for name, b in sorted(detail["billing"].items()):
@@ -360,30 +390,71 @@ def render_show(detail: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
-def render_bill(jobs: List[Dict[str, Any]],
-                tenant: Optional[str] = None) -> str:
-    """Cross-job billing statement for one tenant (or all tenants when
-    ``tenant`` is None): each job's reader-side rollup, then the grand
-    total. Pure jhist read — no AM involvement, so it works on finished
-    and running jobs alike."""
-    rows: List[tuple] = []          # (app_id, tenant, tokens, weight, billed)
+def parse_when(s: Optional[str]) -> Optional[float]:
+    """``--since``/``--until`` value → epoch seconds: raw epoch floats
+    pass through; otherwise local-time ``YYYY-MM-DD`` or ``YYYY-MM-DD
+    HH:MM:SS`` (the formats the list/show renderers print, so a window
+    can be copied straight off their output). None/empty → None."""
+    if not s:
+        return None
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d"):
+        try:
+            return time.mktime(time.strptime(s, fmt))
+        except ValueError:
+            continue
+    raise ValueError(f"unparseable time {s!r} (want epoch seconds, "
+                     f"YYYY-MM-DD or 'YYYY-MM-DD HH:MM:SS')")
+
+
+def bill_rows(jobs: List[Dict[str, Any]], tenant: Optional[str] = None, *,
+              since: Optional[float] = None,
+              until: Optional[float] = None) -> List[Dict[str, Any]]:
+    """The billing statement's structured rows — one per (job, tenant).
+    ``since``/``until`` (epoch seconds) clip the SERVE_WINDOW ledger to
+    a billing window BEFORE the rollup integrates it, so a monthly
+    statement bills only that month's tokens however long the job
+    ran."""
+    rows: List[Dict[str, Any]] = []
     for job in jobs:
         records = ev.read_events(job["path"])
+        if since is not None or until is not None:
+            records = [
+                r for r in records
+                if (since is None or r.get("timestamp", 0.0) >= since)
+                and (until is None or r.get("timestamp", 0.0) <= until)]
         meta = job.get("metadata") or {}
         for name, b in billing_rollup(records, meta.get("config")).items():
             if tenant is not None and name != tenant:
                 continue
-            rows.append((job["app_id"], name, b["tokens"], b["weight"],
-                         b["billed"]))
+            rows.append({"app_id": job["app_id"], "tenant": name,
+                         "tokens": b["tokens"], "weight": b["weight"],
+                         "billed": b["billed"]})
+    return rows
+
+
+def render_bill(jobs: List[Dict[str, Any]],
+                tenant: Optional[str] = None, *,
+                since: Optional[float] = None,
+                until: Optional[float] = None) -> str:
+    """Cross-job billing statement for one tenant (or all tenants when
+    ``tenant`` is None): each job's reader-side rollup, then the grand
+    total. Pure jhist read — no AM involvement, so it works on finished
+    and running jobs alike."""
+    rows = bill_rows(jobs, tenant, since=since, until=until)
     who = tenant if tenant is not None else "any tenant"
     if not rows:
         return f"no serve-window ledgers found for {who}"
     out = [f"{'APP ID':<28} {'TENANT':<10} {'TOKENS':>12} "
            f"{'WEIGHT':>7} {'BILLED':>12}"]
-    for app_id, name, tok, w, billed in rows:
-        out.append(f"{app_id:<28} {name:<10} {tok:>12.0f} "
-                   f"{w:>7g} {billed:>12.0f}")
-    total = sum(r[4] for r in rows)
+    for r in rows:
+        out.append(f"{r['app_id']:<28} {r['tenant']:<10} "
+                   f"{r['tokens']:>12.0f} {r['weight']:>7g} "
+                   f"{r['billed']:>12.0f}")
+    total = sum(r["billed"] for r in rows)
     out.append(f"{'TOTAL':<28} {'':<10} {'':>12} {'':>7} {total:>12.0f}")
     return "\n".join(out)
 
@@ -522,6 +593,37 @@ def _job_page(detail: Dict[str, Any]) -> str:
                 f"<td>{mark}</td>"
                 f"<td>{html.escape(str(p.get('detail') or ''))}</td></tr>")
         parts.append("</table>")
+    if detail.get("publications") or detail.get("swaps"):
+        parts.append("<h3>Publication timeline</h3><table><tr>"
+                     "<th>time</th><th>event</th><th>who</th>"
+                     "<th>version</th><th>step</th><th>wall s</th>"
+                     "<th>ok</th><th>detail</th></tr>")
+        merged = sorted(
+            [("PUBLISH", p) for p in detail.get("publications", [])]
+            + [("SWAP", p) for p in detail.get("swaps", [])],
+            key=lambda kp: kp[1]["timestamp"])
+        for kind, p in merged:
+            when = time.strftime("%H:%M:%S", time.localtime(p["timestamp"]))
+            if kind == "PUBLISH":
+                parts.append(
+                    f"<tr><td>{when}</td><td>PUBLISH</td><td>train</td>"
+                    f"<td>v{p.get('version')}</td><td>{p.get('step')}</td>"
+                    f"<td></td><td></td>"
+                    f"<td>{html.escape(str(p.get('note') or ''))}</td></tr>")
+            else:
+                mark = ("<b class='ok'>ok</b>" if p.get("ok")
+                        else "<b class='bad'>failed</b>")
+                parts.append(
+                    f"<tr><td>{when}</td><td>SWAP</td>"
+                    f"<td>{html.escape(str(p.get('job_type')))}:"
+                    f"{p.get('index')}</td>"
+                    f"<td>v{p.get('from_version')}&rarr;"
+                    f"v{p.get('to_version')}</td><td>{p.get('step')}</td>"
+                    f"<td>{float(p.get('wall_s', 0.0)):.2f}</td>"
+                    f"<td>{mark}</td>"
+                    f"<td>{html.escape(str(p.get('detail') or ''))}</td>"
+                    f"</tr>")
+        parts.append("</table>")
     if detail.get("billing"):
         parts.append("<h3>Billing</h3><table><tr><th>tenant</th>"
                      "<th>tokens</th><th>weight</th><th>billed</th></tr>")
@@ -651,7 +753,26 @@ def main(args) -> int:
         # The app_id positional doubles as the tenant name: `tony
         # history bill gold` rolls up gold's billed tokens across every
         # job the history scan can see; with no tenant, all tenants.
-        print(render_bill(gather_jobs(history_dir), args.app_id or None))
+        try:
+            since = parse_when(getattr(args, "since", None))
+            until = parse_when(getattr(args, "until", None))
+        except ValueError as e:
+            print(f"tony history bill: {e}")
+            return 2
+        jobs = gather_jobs(history_dir)
+        tenant = args.app_id or None
+        if getattr(args, "json", False):
+            print(json.dumps(bill_rows(jobs, tenant, since=since,
+                                       until=until),
+                             indent=2, sort_keys=True))
+        elif getattr(args, "csv", False):
+            rows = bill_rows(jobs, tenant, since=since, until=until)
+            print("app_id,tenant,tokens,weight,billed")
+            for r in rows:
+                print(f"{r['app_id']},{r['tenant']},{r['tokens']:.0f},"
+                      f"{r['weight']:g},{r['billed']:.0f}")
+        else:
+            print(render_bill(jobs, tenant, since=since, until=until))
         return 0
     if args.action == "serve":
         # Loopback by default: jhist pages expose full job configs; binding

@@ -1,0 +1,134 @@
+"""Continuous weight publication: the train->serve pointer plane.
+
+PR 3 gave checkpoints an atomic commit (stage -> fsync -> rename, a
+step directory counts only once its manifest is inside); PR 19 gave the
+serve side an elastic restore that can land any committed manifest on a
+live mesh. This module closes the loop between them with ONE small
+durable artifact: a versioned pointer file ``published.json`` in the
+checkpoint root, naming the committed step the serving fleet should be
+running.
+
+The pointer is the whole protocol:
+
+* the TRAIN side (``train_loop``'s ``publish_every`` knob, or the
+  ``tony publish`` CLI) advances it — only ever to a step that
+  :func:`tony_tpu.ckpt.format.committed_steps` proves committed, and
+  only through the same stage-and-rename idiom the ckpt commit itself
+  uses, so a SIGKILL anywhere leaves the OLD pointer or the NEW one,
+  never a torn file;
+* the SERVE side (executor heartbeats via :func:`latest_publication`,
+  the AM's rolling-swap tick, ``tony serve --follow``) reads it —
+  jax-free and failure-silent, because a publication probe runs on
+  every heartbeat and a half-visible NFS read must degrade to "no news"
+  rather than kill the beat.
+
+Versions are a monotonically increasing integer minted here (previous
+pointer's version + 1, starting at 1), NOT the step number: a rollback
+publication re-points at an OLDER step with a NEWER version, and the
+fleet swap logic only ever compares versions. The chaos sites
+(``publish_before_stage`` / ``publish_after_stage`` /
+``publish_after_replace``) follow the history-rotation naming so the
+crash sweep in tests/test_publish.py can prove the old-or-new claim at
+each boundary.
+
+Layering: jax-free at import (the control-plane rule) — this module is
+read by the AM, the executor heartbeat loop, and the CLI, none of which
+may drag in an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from tony_tpu import chaos
+from tony_tpu.ckpt.format import MANIFEST_NAME, _fsync_dir, \
+    committed_steps, step_dir
+
+__all__ = ["PUBLISH_FILE", "PublishError", "publish_step",
+           "latest_publication"]
+
+# Lives in the checkpoint ROOT, next to the step_%08d dirs it points
+# into — one rename away from every manifest it can name, so pointer
+# and checkpoint are always on the same filesystem (os.replace must be
+# atomic between them).
+PUBLISH_FILE = "published.json"
+
+
+class PublishError(RuntimeError):
+    """The publication cannot be made (uncommitted step, missing ckpt
+    root). Typed so callers distinguish "nothing to publish yet" from a
+    broken pointer write — the CLI surfaces it as a clean error, the
+    train loop as a hard fault (publishing an uncommitted step would
+    hand the fleet a manifest that may never exist)."""
+
+
+def publish_step(ckpt_dir: str | Path, step: Optional[int] = None, *,
+                 note: str = "") -> Dict[str, Any]:
+    """Advance the pointer to ``step`` (default: the newest committed
+    step) and return the new record. The step MUST already be committed
+    — the pointer may only ever name a manifest a restore can land, and
+    the async checkpointer's caller is responsible for ``wait()``-ing
+    its own commit before publishing it.
+
+    Crash-safe by stage-and-rename: the tmp file is fsynced before the
+    rename and the directory after it, and the three declared chaos
+    sites bracket both moves. Re-publishing the same step mints a new
+    version (an explicit re-push is a fleet-wide "converge again"
+    signal, not a no-op).
+    """
+    root = Path(ckpt_dir)
+    steps = committed_steps(root)
+    if step is None:
+        if not steps:
+            raise PublishError(f"no committed checkpoint under {root} "
+                               f"— nothing to publish")
+        step = steps[-1]
+    step = int(step)
+    if step not in steps:
+        raise PublishError(
+            f"step {step} is not committed under {root} "
+            f"(committed: {steps[-5:] if steps else []}) — a pointer "
+            f"must only name a manifest a restore can land")
+    prev = latest_publication(root)
+    record = {
+        "version": (int(prev["version"]) + 1) if prev else 1,
+        "step": step,
+        "manifest": f"{step_dir(root, step).name}/{MANIFEST_NAME}",
+        "published_at": time.time(),
+        "note": str(note),
+    }
+    target = root / PUBLISH_FILE
+    tmp = root / (PUBLISH_FILE + ".tmp")
+    chaos.crash_point("publish_before_stage")
+    with open(tmp, "w") as f:
+        json.dump(record, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    chaos.crash_point("publish_after_stage")
+    os.replace(tmp, target)
+    chaos.crash_point("publish_after_replace")
+    _fsync_dir(root)
+    return record
+
+
+def latest_publication(ckpt_dir: str | Path) -> Optional[Dict[str, Any]]:
+    """The current pointer record, or ``None`` when nothing was ever
+    published (or the file is unreadable/malformed — failure-silent BY
+    CONTRACT: this runs inside every executor heartbeat and the AM
+    tick, where a transiently half-visible network filesystem must read
+    as "no publication news", never kill the probe). A well-formed
+    record always carries integer ``version`` and ``step``."""
+    try:
+        with open(Path(ckpt_dir) / PUBLISH_FILE) as f:
+            rec = json.load(f)
+        if not isinstance(rec, dict):
+            return None
+        rec["version"] = int(rec["version"])
+        rec["step"] = int(rec["step"])
+        return rec
+    except (OSError, ValueError, TypeError, KeyError):
+        return None

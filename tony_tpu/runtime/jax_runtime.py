@@ -187,6 +187,20 @@ class JAXTaskAdapter(MLGenericTaskAdapter):
                 ctx.conf.get_int(conf_mod.CKPT_EVERY, 0))
             env[constants.ENV_CKPT_KEEP] = str(
                 ctx.conf.get_int(conf_mod.CKPT_KEEP, 3))
+            # Continuous publication (tony_tpu.publish): the pointer
+            # cadence rides the ckpt wiring — a publication names a
+            # committed step in this same directory, so the knob is
+            # meaningless without tony.ckpt.dir.
+            publish_every = ctx.conf.get_int(conf_mod.PUBLISH_EVERY, 0)
+            if publish_every > 0:
+                env[constants.ENV_PUBLISH_EVERY] = str(publish_every)
+        # Shared per-gang train AOT cache (tony_tpu.ckpt.aot): every
+        # worker points at one durable cache dir — the first to lower a
+        # (mesh, geometry) step populates it, the rest (and post-resize
+        # re-gangs) deserialize instead of re-tracing.
+        train_aot = ctx.conf.get(conf_mod.TRAIN_AOT_CACHE)
+        if train_aot:
+            env[constants.ENV_TRAIN_AOT_CACHE] = train_aot
         # Input-data plane (tony_tpu.data): ship the stream seed so every
         # process — and every gang RESTART — builds the identical
         # deterministic example stream (Dataset's default seed). The

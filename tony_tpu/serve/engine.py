@@ -255,6 +255,17 @@ class PagedModelRunner:
         # forward dominates wall time, so fewer launches for the same
         # tokens IS the batching/speculation win.
         self.forwards = 0
+        # Weight publication (tony_tpu.publish / serve.swap): which
+        # published pointer version (and its ckpt step) the live params
+        # came from — 0/0 until a publication is known. The version
+        # rides every stats publish so the router and the history plane
+        # can prove which weights answered which request; ``swapping``
+        # gates admission during a hot swap's quiesce window (and rides
+        # the heartbeat so the router down-marks the replica).
+        self.weight_version = 0
+        self.weight_step = 0
+        self.weight_swaps = 0
+        self.swapping = False
 
     def _fn(self, b: int, t: int) -> Callable:
         """The cached view of :func:`build_step_fn` — prefill, decode,
@@ -384,6 +395,43 @@ class PagedModelRunner:
         self.cache.k, self.cache.v = pk, pv
         self.forwards += 1
         return logits
+
+    def swap_params(self, new_params: Any, *, version: int,
+                    step: int) -> None:
+        """Flip the live param tree to ``new_params`` — the hot-swap
+        plane's commit point (tony_tpu.serve.swap). The CALLER owns the
+        iteration-boundary contract: no launch may be in flight (the
+        replica runs this under the front's drive lock after a
+        quiesce), because ``_run_fn`` reads ``self.params`` fresh per
+        launch and the flip is a single reference store — the next
+        launch runs the new weights whole, no launch ever sees a mix.
+
+        Atomic-or-rolled-back: the new tree must match the old one's
+        structure, shapes, and dtypes EXACTLY — any drift raises
+        :class:`~tony_tpu.serve.swap.SwapError` with the old params
+        still live (a publication whose manifest changed geometry needs
+        a restart, not a swap). A same-geometry flip is what keeps the
+        compiled plane valid: the AOT fingerprint digests avals, not
+        values, so every jitted/AOT executable survives — a swap costs
+        zero recompiles."""
+        from tony_tpu.serve.swap import SwapError
+
+        old_leaves, old_def = jax.tree.flatten(self.params)
+        new_leaves, new_def = jax.tree.flatten(new_params)
+        if old_def != new_def:
+            raise SwapError(
+                f"param tree structure changed: {len(old_leaves)} vs "
+                f"{len(new_leaves)} leaves — the published manifest is "
+                f"not this engine's geometry; old weights kept")
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            if o.shape != n.shape or o.dtype != n.dtype:
+                raise SwapError(
+                    f"param leaf {i} changed aval: {o.shape}/{o.dtype} "
+                    f"-> {n.shape}/{n.dtype}; old weights kept")
+        self.params = new_params
+        self.weight_version = int(version)
+        self.weight_step = int(step)
+        self.weight_swaps += 1
 
 
 class ServeEngine(PagedModelRunner):
@@ -524,6 +572,15 @@ class ServeEngine(PagedModelRunner):
         self.prefill_launches = 0
         self.prefill_rows = 0
         self.prefill_chunks = 0
+        # Prompt-length histogram, bucketed by the PADDED prefill length
+        # (the compile-relevant quantity): submit-time counts keyed by
+        # the q_block-multiple pad a monolithic prefill of that prompt
+        # launches at. Rides stats() as the one dict-of-scalars next to
+        # tenants, and the SERVE_WINDOW event log accumulates it — the
+        # warm() pad self-tuner (serve.swap.derive_prefill_pads) reads
+        # the logged histogram back instead of a caller guessing
+        # prefill_pads by hand.
+        self._prompt_hist: Dict[int, int] = {}
         # Telemetry: completion ring for p50/p99, monotonic counters for
         # rates — O(1) per step, million-request safe.
         # (t_done, latency_s, n_tokens) per completion: rates and
@@ -606,6 +663,12 @@ class ServeEngine(PagedModelRunner):
                         needed_blocks=needed,
                         free_blocks=self.cache.free_blocks)
             self._queue.append((req, time.monotonic()))
+            # Histogram at the padded prefill length (the shape a
+            # monolithic prefill of this prompt compiles), counted only
+            # for ACCEPTED submissions — the pad self-tuner must learn
+            # the shapes the engine actually launches.
+            pad = -(-len(req.tokens) // self.q_block) * self.q_block
+            self._prompt_hist[pad] = self._prompt_hist.get(pad, 0) + 1
 
     @property
     def queue_depth(self) -> int:
@@ -909,6 +972,13 @@ class ServeEngine(PagedModelRunner):
             seq.hkey = keys[matched - 1]
 
     def _join(self, results: List[Completion]) -> None:
+        # Hot-swap quiesce (tony_tpu.serve.swap): admission pauses while
+        # the swap drains the batch — in-flight sequences complete under
+        # the OLD weights, queued requests stay queued and admit AFTER
+        # the flip under the new ones, so no request ever spans weight
+        # versions and none is dropped.
+        if self.swapping:
+            return
         if self.join_policy == "static" and (self._running
                                              or self._prefilling):
             return
@@ -1478,6 +1548,7 @@ class ServeEngine(PagedModelRunner):
                 if ten is not None:
                     tenant_queued[ten] = tenant_queued.get(ten, 0) + 1
             rejections = self.admission_rejections
+            prompt_hist = dict(self._prompt_hist)
         recent = [(l, n, ten) for t, l, n, ten in events
                   if now - t <= self.stats_window_s]
         lat = sorted(l for l, _, _ in recent)
@@ -1588,6 +1659,25 @@ class ServeEngine(PagedModelRunner):
             "admission_rejections": float(rejections),
             "qos_deferrals": float(self.qos_deferrals),
             "tenants": tenants,
+            # Continuous-publication telemetry (PR 20): which weight
+            # version this replica is serving, and whether it is inside
+            # a swap window right now. weight_version rides the
+            # heartbeat so the AM's rolling fleet swap can tell who
+            # still needs the new manifest; swapping=1.0 is the
+            # router's down-mark signal (refresh_from_task_infos
+            # retires the replica for the window, the next clean beat
+            # revives it). prompt_hist is the padded-prefill-length
+            # histogram warm() self-tunes from — dict of str(pad) →
+            # count, the same one-level dict-of-scalars shape the
+            # tenants dict established, so normalize_serve_telemetry
+            # passes it through unchanged. All zeros / empty on an
+            # unswapped engine: the uniform-schema rule.
+            "weight_version": float(self.weight_version),
+            "weight_step": float(self.weight_step),
+            "weight_swaps": float(self.weight_swaps),
+            "swapping": 1.0 if self.swapping else 0.0,
+            "prompt_hist": {str(k): float(v)
+                            for k, v in prompt_hist.items()},
         }
         stats.update(self._extra_stats())
         _record(f"{self.tag}_stats", **stats)
@@ -1650,6 +1740,28 @@ class ServeEngine(PagedModelRunner):
             was = self.warm_standby
             self.warm_standby = False
         return was
+
+    # -- hot weight swap (tony_tpu.serve.swap) -----------------------------
+    def swap_params(self, new_params: Any, *, version: int,
+                    step: int) -> None:
+        """The serve engine's hot swap: the base flip (geometry-checked,
+        atomic-or-rolled-back, zero recompiles) plus the KV hygiene the
+        bitwise contract needs — every published prefix block and every
+        demoted host stem holds rows COMPUTED UNDER THE OLD WEIGHTS, so
+        a post-swap admission adopting them would stream a mixed-version
+        answer. The device index and the host stem tier flush (the rows
+        recompute fresh, bit-identical to a fresh replica restored from
+        the same manifest); parked CONVERSATIONS survive — their records
+        are an explicit continuity contract (the resumed turn keeps its
+        pre-swap history's KV, the documented tradeoff the re-published
+        parked digest advertises)."""
+        super().swap_params(new_params, version=version, step=step)
+        self.cache.flush_prefix()
+        # Stem-export bookkeeping refers to the flushed keys — a
+        # post-swap export must only ever name new-weight chains.
+        self._chain_parent.clear()
+        self._hot_tips.clear()
+        self._stored_tips.clear()
 
     # -- static-analysis hook ---------------------------------------------
     def decode_traced(self, batch: Optional[int] = None):
@@ -1734,3 +1846,26 @@ class EngineFront:
             # Another thread may own the completion we need next round;
             # yield so it can collect.
             time.sleep(0)
+
+    def quiesce_and_swap(self, fn: Callable[[], None]) -> None:
+        """Drain the engine to an iteration boundary and run ``fn`` (the
+        weight flip) there, without dropping a request. Under the drive
+        lock: set ``engine.swapping`` (the ``_join`` gate — queued
+        requests stay queued), step the engine until every in-flight
+        sequence completes under the OLD weights (completions stash into
+        ``_done`` exactly as a caller's own drive turn would, so
+        concurrent ``_drive_until`` threads blocked on the lock collect
+        them the moment we release), call ``fn`` at the drained
+        boundary, then clear the gate — the queued backlog admits on the
+        next step under the NEW weights. No request ever spans weight
+        versions; none is dropped. A failed flip propagates after the
+        gate clears: the engine keeps serving the old weights."""
+        with self._drive:
+            self.engine.swapping = True
+            try:
+                while self.engine._running or self.engine._prefilling:
+                    for c in self.engine.step():
+                        self._done[c.rid] = c
+                fn()
+            finally:
+                self.engine.swapping = False

@@ -733,6 +733,28 @@ class PagedKVCache:
         surface for the host-tier partition invariants)."""
         return list(self._host_index)
 
+    def flush_prefix(self) -> int:
+        """Invalidate every published prefix entry — the hot-weight-swap
+        hygiene step (tony_tpu.serve.swap): indexed blocks and demoted
+        host stems hold rows computed under the OLD weights, so a
+        post-swap admission adopting any of them would stream a
+        mixed-version answer. Unindexes every chain key (refcount-0
+        LRU residents move to the free list; a still-referenced block
+        keeps its rows until its sequence releases it, but can no
+        longer be adopted) and drops the whole host stem tier. Parked
+        conversation records are deliberately KEPT — continuity is
+        their explicit contract (engine docs). Returns entries
+        invalidated (device + host)."""
+        n = len(self._index) + len(self._host_index)
+        for b in list(self._lru):
+            del self._lru[b]
+            self._free.append(b)
+        for b in list(self._key_of):
+            key = self._key_of.pop(b)
+            self._index.pop(key, None)
+        self._host_index.clear()
+        return n
+
     def export_keys(self, keys: Sequence[str]) -> List[Dict[str, Any]]:
         """Wire payloads of the device blocks indexed under ``keys``
         (every key must be indexed — the persistent prefix store only

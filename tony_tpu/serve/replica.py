@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from tony_tpu.conf import (CKPT_DIR, SERVE_AOT_CACHE, SERVE_BLOCK_SIZE,
@@ -88,9 +89,20 @@ class Replica:
             self._aot = AOTCache(aot_cache)
         self.model = get_model(model_name, **(model_kwargs or {}))
         self.mesh = mesh
+        # Continuous publication (tony_tpu.publish): a published pointer
+        # outranks "latest committed" — the pointer is the train gang's
+        # statement of which step the fleet should serve, and a replica
+        # that came up mid-stream must match the fleet it joins.
+        self.ckpt_dir = ckpt_dir
+        self.dtype_policy = dtype_policy
+        self.q_block = q_block
+        self.ctx_max = ctx_max
+        from tony_tpu.publish import latest_publication
+
+        pub = latest_publication(ckpt_dir)
         params, step, prefix = self._restore_params(
             self.model, ckpt_dir, dtype_policy=dtype_policy, mesh=mesh,
-            q_block=q_block)
+            q_block=q_block, step=pub["step"] if pub else None)
         self.restored_step = step
         if spec_k:
             # Speculative lane (tony_tpu.serve.spec): draft-and-verify.
@@ -132,6 +144,13 @@ class Replica:
                 warm_standby=warm_standby,
                 demote_watermark=demote_watermark,
                 demote_batch=demote_batch, qos=qos)
+        # Seed the serving version: a replica restored from a published
+        # step advertises that version on its very first heartbeat, so
+        # the AM's rolling swap never re-swaps a replica that already
+        # came up on the target.
+        self.engine.weight_step = int(step)
+        if pub is not None and pub["step"] == step:
+            self.engine.weight_version = pub["version"]
         trace_record("serve", "replica", model=model_name,
                      ckpt_step=step, path_prefix=prefix,
                      dtype_policy=dtype_policy, spec_k=int(spec_k),
@@ -191,10 +210,13 @@ class Replica:
     @staticmethod
     def _restore_params(model: Any, ckpt_dir: str, *,
                         dtype_policy: Optional[str], mesh: Optional[Any],
-                        q_block: int):
+                        q_block: int, step: Optional[int] = None):
         """Elastic params-only restore onto the replica's mesh — shared
         by the target and the speculative lane's draft model (both are
-        trained checkpoints; neither may initialize fresh weights)."""
+        trained checkpoints; neither may initialize fresh weights).
+        ``step`` pins a specific committed step — the hot-swap path and
+        the published-pointer startup both restore a NAMED manifest,
+        never whatever happens to be latest when the restore runs."""
         import flax.linen as nn
         import jax
         import jax.numpy as jnp
@@ -216,7 +238,8 @@ class Replica:
                 template = jax.jit(init)()
         else:
             template = init()
-        step = ckpt.latest_step(ckpt_dir)
+        if step is None:
+            step = ckpt.latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(
                 f"no committed checkpoint under {ckpt_dir} — a replica "
@@ -272,6 +295,97 @@ class Replica:
         if was and self._publish is not None:
             self._publish()
         return was
+
+    # -- hot weight swap (tony_tpu.serve.swap) -----------------------------
+    def hot_swap(self, *, version: Optional[int] = None,
+                 step: Optional[int] = None) -> Dict[str, Any]:
+        """Swap this replica onto a published manifest IN PLACE —
+        no container restart, no dropped request, no recompile.
+
+        Three phases, and only the last needs the drive lock:
+
+        1. resolve the target (the published pointer, or an explicit
+           ``step`` pin) — pure pointer reads;
+        2. restore the params subtree through the SAME elastic/dtype-
+           policy path startup used, onto the live mesh, while the
+           engine KEEPS SERVING the old weights (the disk + device_put
+           minutes cost zero downtime);
+        3. quiesce to an iteration boundary under the front's drive
+           lock and flip (``EngineFront.quiesce_and_swap`` →
+           ``ServeEngine.swap_params``): in-flight sequences finished
+           under the old weights, the queued backlog admits under the
+           new, the prefix/host tiers flushed, parked conversations
+           kept.
+
+        Any failure raises :class:`SwapError` with the old weights
+        still serving (atomic-or-rolled-back); success republishes
+        stats immediately so the router's down-mark lifts on the next
+        heartbeat, not the next publish tick. The speculative lane's
+        draft model is NOT swapped — it is a different checkpoint
+        lineage; republish it by rolling the replica."""
+        from tony_tpu import chaos
+        from tony_tpu.serve.swap import SwapError, resolve_target
+
+        t0 = time.monotonic()
+        to_version, to_step = resolve_target(self.ckpt_dir,
+                                             version=version, step=step)
+        from_version = self.engine.weight_version
+        chaos.crash_point("swap_before_restore")
+        try:
+            params, rstep, _ = self._restore_params(
+                self.model, self.ckpt_dir, dtype_policy=self.dtype_policy,
+                mesh=self.mesh, q_block=self.q_block, step=to_step)
+        except SwapError:
+            raise
+        except Exception as exc:   # noqa: BLE001 — typed rollback contract
+            raise SwapError(f"restore of step {to_step} failed: "
+                            f"{type(exc).__name__}: {exc}") from exc
+        chaos.crash_point("swap_after_restore")
+
+        def flip() -> None:
+            chaos.crash_point("swap_before_flip")
+            self.engine.swap_params(params, version=to_version,
+                                    step=to_step)
+            chaos.crash_point("swap_after_flip")
+
+        self._front.quiesce_and_swap(flip)
+        self.restored_step = rstep
+        if self._publish is not None:
+            self._publish()
+        return {"ok": True, "from_version": from_version,
+                "to_version": to_version, "step": to_step,
+                "wall_s": time.monotonic() - t0}
+
+    def tune_warm_pads(self, history_dir: str, *,
+                       limit: int = 4) -> List[int]:
+        """warm() pad self-tuning (tony_tpu.serve.swap): read the
+        prompt-length histograms earlier serve windows logged under
+        ``history_dir`` and precompile the prefill pads the traffic
+        actually used — the data-driven replacement for a caller-named
+        ``prefill_pads=`` guess. Best-effort: an unreadable log warms
+        nothing extra, never fails startup."""
+        from tony_tpu import events as ev
+        from tony_tpu.serve.swap import derive_prefill_pads
+
+        records: List[Dict[str, Any]] = []
+        try:
+            for job in ev.list_jobs(history_dir):
+                try:
+                    records += [r for r in ev.read_events(job["path"])
+                                if r.get("type") == ev.SERVE_WINDOW]
+                except (OSError, ValueError):
+                    continue
+        except OSError:
+            return []
+        pads = derive_prefill_pads(
+            records, q_block=self.engine.q_block,
+            ctx_max=self.ctx_max, limit=limit)
+        if pads:
+            n = self.engine.warm(prefill_pads=pads)
+            print(f"[tony-serve-replica] self-tuned prefill pads "
+                  f"{pads} from the serve history ({n} program(s) "
+                  f"resolved)", flush=True)
+        return pads
 
     # -- RPC front ---------------------------------------------------------
     def rpc_handler(self) -> "_ReplicaRpcHandler":
@@ -386,6 +500,15 @@ class _ReplicaRpcHandler:
         False and changes nothing)."""
         return self.replica.promote()
 
+    def rpc_swap(self, version: Optional[int] = None,
+                 step: Optional[int] = None) -> Dict[str, Any]:
+        """The AM's rolling-fleet verb: hot-swap this replica onto the
+        published manifest (or an explicit ``step`` pin). A failure
+        transports as ``"SwapError: ..."`` on the JSON-lines wire —
+        the replica is still serving its OLD weights when the AM
+        reads it (atomic-or-rolled-back)."""
+        return self.replica.hot_swap(version=version, step=step)
+
 
 def main() -> int:
     """``python -m tony_tpu.serve.replica`` — the serve job type's user
@@ -461,6 +584,16 @@ def main() -> int:
         demote_watermark=float(conf.get(SERVE_DEMOTE_WATERMARK) or 0.0),
         demote_batch=conf.get_int(SERVE_DEMOTE_BATCH, 0),
         qos=qos)
+    # warm() pad self-tuning (tony_tpu.serve.swap): when the cold-start
+    # plane is armed and a history root is configured, precompile the
+    # prefill pads earlier serve traffic actually used — the histogram
+    # in the SERVE_WINDOW records replaces the caller-named
+    # prefill_pads= guess.
+    from tony_tpu.conf import HISTORY_LOCATION
+
+    history_dir = conf.get(HISTORY_LOCATION)
+    if history_dir and (conf.get(SERVE_AOT_CACHE) or warm_standby):
+        replica.tune_warm_pads(history_dir)
     replica.serve_forever(
         port=conf.get_int(SERVE_PORT, 0),
         stats_path=os.environ.get(constants.ENV_SERVE_STATS))

@@ -249,6 +249,16 @@ class RequestRouter:
             host = info.get("host")
             if not port or not host:
                 continue            # not serving yet (no stats file)
+            # Hot-swap down-mark (tony_tpu.serve.swap): a replica
+            # inside its swap window advertises swapping=1.0 — retire
+            # it for the window so new requests land on the rest of
+            # the fleet (warm standbys cover the gap). The swap's
+            # immediate post-flip stats republish clears the flag, and
+            # the next refresh's upsert revives the replica
+            # (retired=False) — no separate re-admit verb.
+            if metrics.get("swapping"):
+                self.retire_replica(name)
+                continue
             self.upsert_replica(name, address=f"{host}:{int(port)}",
                                 stats=metrics)
 

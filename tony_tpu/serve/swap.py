@@ -1,0 +1,225 @@
+"""Hot weight swap: serve fleets follow the train gang's publications.
+
+The other half of :mod:`tony_tpu.publish` — the train gang stages a
+versioned pointer file over its committed checkpoints; this module is
+everything the SERVE side needs to roll onto it without dropping a
+request or burning a container:
+
+* :class:`SwapError` — the typed atomic-or-rolled-back failure. A swap
+  that raises it left the engine serving the OLD weights whole; the
+  caller retries or gives up, the replica never serves a mix.
+* :func:`resolve_target` — pointer → (version, step): which committed
+  manifest a swap should restore. Shared by the replica's ``swap`` RPC
+  verb and the AM's publication tick, so both sides agree on the target
+  by construction.
+* :class:`FleetSwapController` — the AM's rolling-swap pacing: ONE
+  replica in flight at a time (warm standbys first — they cover the
+  routed gap — then actives by index), a per-replica wall-clock
+  timeout, and a cooldown after a failure so a poisoned manifest does
+  not hammer the fleet. Pure decision logic over an injected clock:
+  unit-testable without an AM, a replica, or jax.
+* :func:`derive_prefill_pads` — warm()'s pad self-tuner: read the
+  prompt-length histogram the engines publish into the SERVE_WINDOW
+  event records and return the pads worth precompiling, replacing the
+  caller-named ``prefill_pads=`` guess with what the traffic actually
+  looked like.
+
+The swap itself happens in :meth:`tony_tpu.serve.replica.Replica.
+hot_swap` (restore OUTSIDE the drive lock, flip inside
+``EngineFront.quiesce_and_swap`` at a drained iteration boundary) and
+:meth:`tony_tpu.serve.engine.ServeEngine.swap_params` (geometry-checked
+reference store + prefix/host-stem flush). This module stays jax-free
+at import — it is control-plane code the AM runs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from tony_tpu.ckpt.format import committed_steps
+from tony_tpu.publish import latest_publication
+
+
+class SwapError(RuntimeError):
+    """A hot swap that could not commit. The contract every raiser
+    honors: the engine still holds the OLD params, whole — geometry
+    mismatch, missing manifest, and restore failures all roll back to
+    exactly the weights that were serving before the attempt."""
+
+
+def resolve_target(ckpt_dir: str, *, version: Optional[int] = None,
+                   step: Optional[int] = None) -> Tuple[int, int]:
+    """What a swap should restore: ``(version, step)``.
+
+    Default is the published pointer (:func:`latest_publication`); an
+    explicit ``step`` overrides it (an operator pinning a roll-back
+    target) and mints version 0 when no pointer names it. ``version``
+    asserts the pointer still carries the version the caller saw — a
+    publication racing past it is a :class:`SwapError`, not a silent
+    swap onto weights nobody asked for."""
+    rec = latest_publication(ckpt_dir)
+    if step is not None:
+        step = int(step)
+        if step not in committed_steps(ckpt_dir):
+            raise SwapError(f"step {step} has no committed manifest "
+                            f"under {ckpt_dir}")
+        if rec is not None and rec["step"] == step:
+            return rec["version"], step
+        return 0, step
+    if rec is None:
+        raise SwapError(f"no publication under {ckpt_dir} — nothing to "
+                        f"swap to (run `tony publish` or arm "
+                        f"publish_every on the train loop)")
+    if version is not None and rec["version"] != int(version):
+        raise SwapError(f"publication moved: wanted version {version}, "
+                        f"pointer now names {rec['version']}")
+    return rec["version"], rec["step"]
+
+
+class FleetSwapController:
+    """Rolling-swap pacing for one serve fleet.
+
+    The AM's publication tick drives it: :meth:`set_target` when a new
+    publication shows up on the heartbeat, :meth:`next_replica` each
+    tick to learn who (if anyone) to swap now, :meth:`begin` /
+    :meth:`finish` around the actual RPC (which the AM runs on a
+    daemon thread — the tick never blocks on a restore). Invariants:
+
+    * at most ONE replica in flight — the router down-marks the
+      swapping replica, and the rest of the fleet must carry its
+      traffic, so a second concurrent swap would halve capacity;
+    * warm standbys swap FIRST (they serve no traffic — free dry runs
+      that validate the manifest before any active risks its window),
+      then actives in index order;
+    * a failure opens a ``cooldown_s`` window before the next attempt,
+      and :meth:`check_timeout` reaps an attempt whose thread wedged
+      past ``timeout_s`` so the fleet is never stuck behind one hung
+      restore.
+
+    ``swap_fn`` is injected — ``(replica_id) -> None``, raising on
+    failure — so tests drive the whole policy with a stub fleet and no
+    jax."""
+
+    def __init__(self, swap_fn: Optional[Callable[[Any], None]] = None, *,
+                 timeout_s: float = 120.0, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        # Optional: the AM drives begin()/finish() around its own RPC
+        # thread; run() needs it.
+        self.swap_fn = swap_fn
+        self.timeout_s = float(timeout_s)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self.target: Optional[Tuple[int, int]] = None
+        self.in_flight: Optional[Any] = None
+        self.swapped = 0
+        self.failed = 0
+        self._started = 0.0
+        self._cooldown_until = 0.0
+
+    def set_target(self, version: int, step: int) -> bool:
+        """Adopt a publication as the fleet's swap target. Returns True
+        the first time this (strictly newer) version is seen — the
+        AM emits its one PUBLISH event on that edge."""
+        version, step = int(version), int(step)
+        if self.target is not None and version <= self.target[0]:
+            return False
+        self.target = (version, step)
+        # A new target clears a stale failure cooldown: the operator
+        # may have published a FIX for whatever the last attempt hit.
+        self._cooldown_until = 0.0
+        return True
+
+    def next_replica(self, fleet: Iterable[Dict[str, Any]]) -> Optional[Any]:
+        """Who to swap now, or None. ``fleet`` rows carry ``id``,
+        ``version`` (what the replica's heartbeat says it serves),
+        ``standby`` and ``index``; rows already at the target version
+        need nothing."""
+        if self.target is None or self.in_flight is not None:
+            return None
+        if self.clock() < self._cooldown_until:
+            return None
+        want = self.target[0]
+        behind = [r for r in fleet if int(r.get("version", 0)) < want]
+        if not behind:
+            return None
+        behind.sort(key=lambda r: (not bool(r.get("standby")),
+                                   int(r.get("index", 0))))
+        return behind[0]["id"]
+
+    def begin(self, replica_id: Any) -> None:
+        self.in_flight = replica_id
+        self._started = self.clock()
+
+    def finish(self, replica_id: Any, ok: bool) -> None:
+        """Record one attempt's outcome (idempotent against a reaped
+        timeout racing the thread's own late finish)."""
+        if self.in_flight != replica_id:
+            return
+        self.in_flight = None
+        if ok:
+            self.swapped += 1
+        else:
+            self.failed += 1
+            self._cooldown_until = self.clock() + self.cooldown_s
+
+    def check_timeout(self) -> Optional[Any]:
+        """Reap an in-flight attempt past ``timeout_s``: returns the
+        wedged replica id (the AM records ok=False for it) or None."""
+        if self.in_flight is None \
+                or self.clock() - self._started <= self.timeout_s:
+            return None
+        rid, self.in_flight = self.in_flight, None
+        self.failed += 1
+        self._cooldown_until = self.clock() + self.cooldown_s
+        return rid
+
+    def run(self, replica_id: Any) -> Tuple[bool, str, float]:
+        """One attempt, synchronously: begin → ``swap_fn`` → finish.
+        Returns ``(ok, detail, wall_s)`` — what the SWAP event records.
+        The AM calls this on a named daemon thread; tests call it
+        inline."""
+        if self.swap_fn is None:
+            raise ValueError("FleetSwapController.run needs a swap_fn")
+        self.begin(replica_id)
+        t0 = self.clock()
+        try:
+            self.swap_fn(replica_id)
+        except Exception as exc:   # noqa: BLE001 — every failure rolls back
+            self.finish(replica_id, False)
+            return False, f"{type(exc).__name__}: {exc}", self.clock() - t0
+        self.finish(replica_id, True)
+        return True, "", self.clock() - t0
+
+
+def derive_prefill_pads(records: Iterable[Dict[str, Any]], *,
+                        q_block: int = 16, ctx_max: Optional[int] = None,
+                        limit: int = 4) -> List[int]:
+    """warm()'s pad self-tuner: the prefill pads worth precompiling,
+    read from the prompt-length histograms the fleet's engines publish
+    (``prompt_hist`` in every stats window, accumulated into
+    SERVE_WINDOW event records). Sums counts across every record,
+    keeps the ``limit`` most-frequent pads, returns them ascending —
+    feed straight to ``engine.warm(prefill_pads=...)``. Pads that are
+    not multiples of ``q_block`` or exceed ``ctx_max`` are skipped
+    (stale histograms from a differently-padded fleet must not warm
+    programs this engine can never launch). Empty in, empty out: the
+    caller falls back to warming the decode family alone."""
+    counts: Dict[int, float] = {}
+    for rec in records:
+        stats = rec.get("payload", rec).get("stats", rec.get("payload", rec))
+        hist = stats.get("prompt_hist") if isinstance(stats, dict) else None
+        if not isinstance(hist, dict):
+            continue
+        for k, v in hist.items():
+            try:
+                pad, n = int(k), float(v)
+            except (TypeError, ValueError):
+                continue
+            if pad <= 0 or pad % q_block:
+                continue
+            if ctx_max is not None and pad > ctx_max:
+                continue
+            counts[pad] = counts.get(pad, 0.0) + n
+    top = sorted(counts, key=lambda p: (-counts[p], p))[:max(0, int(limit))]
+    return sorted(top)

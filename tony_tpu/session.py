@@ -87,6 +87,11 @@ class TonyTask:
         # Last checkpoint step this task reported committed (heartbeat
         # piggyback; None until a tony.ckpt.dir executor reports one).
         self.ckpt_step: Optional[int] = None
+        # Latest weight-publication pointer this task's heartbeat
+        # announced ({"version": int, "step": int} — tony_tpu.publish):
+        # the AM's rolling fleet swap reads the max version across
+        # tasks as its target. None until a publication exists.
+        self.published: Optional[Dict[str, int]] = None
         # Latest serving telemetry this task piggybacked on its
         # heartbeat (qps / p99_ms / queue_depth / prefix_cache_hit_rate
         # / blocks_shared / prefill_chunks, plus the router's
@@ -148,6 +153,7 @@ class TonyTask:
             "exit_code": self.exit_code,
             "diagnostics": self.diagnostics,
             "ckpt_step": self.ckpt_step,
+            "published": dict(self.published) if self.published else None,
             "elastic": self.elastic,
             "serve_metrics": dict(self.serve_metrics),
             "metrics": dict(self.metrics),
@@ -285,7 +291,8 @@ class TonySession:
 
     def on_heartbeat(self, job_type: str, index: int,
                      ckpt_step: Optional[int] = None,
-                     serve: Optional[Dict[str, float]] = None) -> None:
+                     serve: Optional[Dict[str, float]] = None,
+                     published: Optional[Dict[str, int]] = None) -> None:
         t = self.task(job_type, index)
         t.touch()
         if ckpt_step is not None:
@@ -295,6 +302,12 @@ class TonySession:
                 t.serve_metrics = util.normalize_serve_telemetry(serve)
             except (TypeError, ValueError):
                 pass          # malformed telemetry must not sink liveness
+        if published:
+            try:
+                t.published = {"version": int(published["version"]),
+                               "step": int(published["step"])}
+            except (TypeError, ValueError, KeyError):
+                pass          # same contract: advisory, never liveness
 
     # -- elastic replica scaling (tony_tpu.serve) --------------------------
     def add_task(self, job_type: str) -> TonyTask:

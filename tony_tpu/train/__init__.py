@@ -497,6 +497,27 @@ def make_accum_train_step(loss_of: Callable[[jax.Array,
     return stepper
 
 
+def shared_aot_cache(path: Optional[str] = None):
+    """The gang-shared train AOT cache for ``make_accum_train_step
+    (aot_cache=...)``, or ``None`` when the plane is unarmed — ``path``
+    defaults from the ``TONY_TRAIN_AOT_CACHE`` env ``JAXRuntime``
+    exports (``tony.train.aot-cache``), so a tony-submitted script arms
+    it with one kwarg and runs unchanged everywhere else. Every worker
+    opens the SAME durable directory: the first to lower a (mesh,
+    geometry, lowered-HLO) fingerprint compiles and populates (put is
+    stage-then-rename, first writer wins — concurrent gang mates race
+    safely), the rest deserialize in milliseconds, and an elastic
+    resize's re-gang stops paying a full recompile per topology change
+    (the fingerprint keys the mesh, so each topology caches its own
+    entry once)."""
+    path = path or os.environ.get(constants.ENV_TRAIN_AOT_CACHE) or None
+    if not path:
+        return None
+    from tony_tpu.ckpt.aot import AOTCache
+
+    return AOTCache(path)
+
+
 def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                                                     Tuple[TrainState, Any]],
                batches: Optional[Iterable[Any]] = None, *,
@@ -509,7 +530,8 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                save_final: bool = True,
                on_step: Optional[Callable[[int, Dict[str, Any]],
                                           None]] = None,
-               drain_file: Optional[str] = None):
+               drain_file: Optional[str] = None,
+               publish_every: Optional[int] = None):
     """Drive ``step_fn`` over ``batches`` with integrated elastic
     checkpointing — the control-plane hook the gang-restart contract needs
     (``tony.am.retry-count``): attempt N+1 calls this exactly like attempt
@@ -551,6 +573,17 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
     executor reports that code and the AM records the worker DRAINED,
     not failed.
 
+    ``publish_every=n`` (default: the ``TONY_PUBLISH_EVERY`` env from
+    ``tony.publish.every``) is the continuous-publication knob
+    (:mod:`tony_tpu.publish`): after every n-th periodic save — and the
+    final save — process 0 waits out the async COMMIT (the pointer may
+    only ever name a manifest a restore can land) and advances the ckpt
+    root's versioned ``published.json`` pointer through stage-and-
+    rename. The executor announces the pointer on its heartbeat and the
+    AM's follow mode rolls the serving fleet onto it, so a training
+    gang continuously feeds the replicas it shares a control plane
+    with — no manual checkpoint copying.
+
     Returns ``(state, last_metrics)``.
     """
     from tony_tpu import ckpt as ckpt_mod
@@ -571,6 +604,9 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
         keep = int(os.environ.get(constants.ENV_CKPT_KEEP, "3") or 3)
     if drain_file is None:
         drain_file = os.environ.get(constants.ENV_DRAIN_FILE) or None
+    if publish_every is None:
+        publish_every = int(os.environ.get(constants.ENV_PUBLISH_EVERY,
+                                           "0") or 0)
     mgr = None
     if ckpt_dir:
         from tony_tpu.data import ckptio
@@ -621,6 +657,26 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
     metrics: Dict[str, Any] = {}
     done = 0
     saved_at: Optional[int] = None
+    saves = 0
+    published_step: Optional[int] = None
+
+    def maybe_publish(step: int) -> None:
+        # Continuous publication: the pointer may only advance over a
+        # COMMITTED manifest, so the async save queue drains first
+        # (wait() also re-raises any pending writer failure — a broken
+        # commit must never be published). One writer per gang: only
+        # process 0 advances the pointer, after every process's shards
+        # are inside the commit by the wait barrier.
+        nonlocal published_step
+        if not publish_every or mgr is None or step == published_step:
+            return
+        from tony_tpu import publish as publish_mod
+
+        mgr.wait()
+        if jax.process_index() == 0:
+            publish_mod.publish_step(ckpt_dir, step)
+        published_step = step
+
     try:
         for batch in batches:
             state, metrics = step_fn(state, batch)
@@ -632,6 +688,9 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                 saved_at = int(jax.device_get(state.step)) \
                     if hasattr(state, "step") else done
                 mgr.save(payload(), step=saved_at)
+                saves += 1
+                if publish_every and saves % publish_every == 0:
+                    maybe_publish(saved_at)
             if drain_file is not None and os.path.exists(drain_file):
                 # Drain directive (elastic resize): commit model + cursor
                 # SYNCHRONOUSLY — wait() both drains the async queue and
@@ -649,6 +708,7 @@ def train_loop(state: TrainState, step_fn: Callable[[TrainState, Any],
                 if hasattr(state, "step") else done
             if final != saved_at:
                 mgr.save(payload(), step=final)
+            maybe_publish(final)
         if mgr is not None:
             mgr.wait()
     finally:
